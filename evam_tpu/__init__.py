@@ -1,0 +1,14 @@
+"""evam_tpu — TPU-native edge video analytics serving framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+intel/edge-video-analytics-microservice (EVAM). Where EVAM runs one
+GStreamer pipeline per stream with per-stream OpenVINO inference
+(see reference pipelines/*/pipeline.json), evam_tpu multiplexes all
+active streams into shared, batched, jit-compiled TPU inference
+engines over a `jax.sharding.Mesh`, while keeping EVAM's external
+contracts: the pipeline-definition JSON, the REST routes
+(POST/GET/DELETE /pipelines/{name}/{version}), the published metadata
+schema, the models directory layout, and the MQTT/ZMQ framing.
+"""
+
+__version__ = "0.1.0"

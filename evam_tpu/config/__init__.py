@@ -1,0 +1,10 @@
+from evam_tpu.config.settings import Settings, get_settings, reset_settings
+from evam_tpu.config.interpolate import interpolate_env, interpolate_tree
+
+__all__ = [
+    "Settings",
+    "get_settings",
+    "reset_settings",
+    "interpolate_env",
+    "interpolate_tree",
+]

@@ -1,0 +1,116 @@
+"""Service settings: env < file < pipeline default < request override.
+
+Covers the reference's three config tiers (SURVEY.md §5.6):
+  (a) env vars — RUN_MODE (reference run.sh:26), DETECTION_DEVICE /
+      CLASSIFICATION_DEVICE (docker-compose.yml:58-59), ENABLE_RTSP /
+      RTSP_PORT / ENABLE_WEBRTC / WEBRTC_SIGNALING_SERVER
+      (docker-compose.yml:49-52), MODELS_DIR / PIPELINES_DIR
+      (eii/docker-compose.yml:50-51), PY_LOG_LEVEL / DEV_MODE
+      (evas/__main__.py:36-46), PROFILING_MODE
+      (eii/docker-compose.yml:43);
+  (b) a config file (the reference uses etcd via EII ConfigManager,
+      evas/__main__.py:34 — here a local JSON file with an optional
+      watcher, see evam_tpu/eii/configmgr.py);
+  (c) per-pipeline JSON parameter defaults with per-request overrides
+      (resolved in evam_tpu/graph/params.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from pydantic import BaseModel, Field
+
+
+class TPUSettings(BaseModel):
+    """TPU engine knobs — new surface, no reference equivalent."""
+
+    mesh_shape: list[int] = Field(default_factory=lambda: [-1])
+    mesh_axes: list[str] = Field(default_factory=lambda: ["data"])
+    max_batch: int = 64
+    batch_deadline_ms: float = 8.0
+    precision: str = "bfloat16"
+    donate_buffers: bool = True
+    compile_cache_dir: str = ""
+
+
+class Settings(BaseModel):
+    """Flat service settings resolved from env + optional config file."""
+
+    run_mode: str = "EVA"  # EVA (REST) vs EII (msgbus) — reference run.sh:26-30
+    rest_port: int = 8080  # reference docker-compose.yml:44
+    detection_device: str = "tpu"  # reference default CPU, docker-compose.yml:58
+    classification_device: str = "tpu"  # reference docker-compose.yml:59
+    models_dir: str = "models"  # reference eii/docker-compose.yml:50
+    pipelines_dir: str = "pipelines"  # reference eii/docker-compose.yml:51
+    enable_rtsp: bool = False  # reference docker-compose.yml:49
+    rtsp_port: int = 8554  # reference docker-compose.yml:45,50
+    enable_webrtc: bool = False  # reference docker-compose.yml:51
+    webrtc_signaling_server: str = ""  # reference docker-compose.yml:52
+    log_level: str = "INFO"  # PY_LOG_LEVEL, reference evas/__main__.py:42
+    dev_mode: bool = True  # DEV_MODE, reference evas/__main__.py:36
+    profiling_mode: bool = False  # reference eii/docker-compose.yml:43
+    state_dir: str = ""  # stream-registry persistence (hardening, SURVEY §5.4)
+    tpu: TPUSettings = Field(default_factory=TPUSettings)
+
+    @classmethod
+    def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
+        data: dict = {}
+        if config_file and Path(config_file).exists():
+            data.update(json.loads(Path(config_file).read_text()))
+
+        env = os.environ
+        mapping = {
+            "RUN_MODE": ("run_mode", str),
+            "REST_PORT": ("rest_port", int),
+            "DETECTION_DEVICE": ("detection_device", str),
+            "CLASSIFICATION_DEVICE": ("classification_device", str),
+            "MODELS_DIR": ("models_dir", str),
+            "PIPELINES_DIR": ("pipelines_dir", str),
+            "ENABLE_RTSP": ("enable_rtsp", _parse_bool),
+            "RTSP_PORT": ("rtsp_port", int),
+            "ENABLE_WEBRTC": ("enable_webrtc", _parse_bool),
+            "WEBRTC_SIGNALING_SERVER": ("webrtc_signaling_server", str),
+            "PY_LOG_LEVEL": ("log_level", str),
+            "DEV_MODE": ("dev_mode", _parse_bool),
+            "PROFILING_MODE": ("profiling_mode", _parse_bool),
+            "EVAM_STATE_DIR": ("state_dir", str),
+        }
+        for var, (key, conv) in mapping.items():
+            if var in env:
+                data[key] = conv(env[var])
+
+        tpu = data.setdefault("tpu", {})
+        tpu_mapping = {
+            "EVAM_MAX_BATCH": ("max_batch", int),
+            "EVAM_BATCH_DEADLINE_MS": ("batch_deadline_ms", float),
+            "EVAM_PRECISION": ("precision", str),
+            "EVAM_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
+        }
+        if isinstance(tpu, dict):
+            for var, (key, conv) in tpu_mapping.items():
+                if var in env:
+                    tpu[key] = conv(env[var])
+        return cls.model_validate(data)
+
+
+def _parse_bool(value: str) -> bool:
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
+_settings: Settings | None = None
+
+
+def get_settings() -> Settings:
+    global _settings
+    if _settings is None:
+        _settings = Settings.from_env(os.environ.get("EVAM_CONFIG_FILE"))
+    return _settings
+
+
+def reset_settings() -> None:
+    """Drop the cached settings (tests / hot reload)."""
+    global _settings
+    _settings = None

@@ -1,0 +1,12 @@
+from evam_tpu.graph.spec import StageKind, StageSpec, PipelineSpec
+from evam_tpu.graph.loader import PipelineLoader
+from evam_tpu.graph.params import resolve_parameters, ParameterError
+
+__all__ = [
+    "StageKind",
+    "StageSpec",
+    "PipelineSpec",
+    "PipelineLoader",
+    "resolve_parameters",
+    "ParameterError",
+]

@@ -1,0 +1,4 @@
+from evam_tpu.obs.log import configure_logging, get_logger
+from evam_tpu.obs.metrics import MetricsRegistry, metrics
+
+__all__ = ["configure_logging", "get_logger", "MetricsRegistry", "metrics"]

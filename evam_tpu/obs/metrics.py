@@ -1,0 +1,128 @@
+"""Lightweight in-process metrics, exported in Prometheus text format.
+
+The reference exposes no metrics endpoint (SURVEY.md §5.5); this is a
+required hardening addition: per-stream FPS, batch occupancy, and
+per-stage latency percentiles so the BASELINE targets are
+self-measurable from the service itself.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+def _label_str(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+@dataclass
+class _Histogram:
+    """Fixed-reservoir histogram good enough for p50/p99 reporting."""
+
+    max_samples: int = 4096
+    samples: list[float] = field(default_factory=list)
+    count: int = 0
+    total: float = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if len(self.samples) < self.max_samples:
+            bisect.insort(self.samples, value)
+        else:
+            # Reservoir-style replacement keeps the histogram bounded.
+            idx = self.count % self.max_samples
+            self.samples.pop(idx)
+            bisect.insort(self.samples, value)
+
+    def quantile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        idx = min(len(self.samples) - 1, int(q * len(self.samples)))
+        return self.samples[idx]
+
+
+class MetricsRegistry:
+    """Counters, gauges and histograms with label support."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, str], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, str], float] = {}
+        self._hists: dict[tuple[str, str], _Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0, labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._counters[(name, _label_str(labels))] += value
+
+    def set(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._gauges[(name, _label_str(labels))] = value
+
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            key = (name, _label_str(labels))
+            if key not in self._hists:
+                self._hists[key] = _Histogram()
+            self._hists[key].observe(value)
+
+    def time(self, name: str, labels: dict[str, str] | None = None):
+        """Context manager observing elapsed seconds into a histogram."""
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.observe(name, time.perf_counter() - self.t0, labels)
+                return False
+
+        return _Timer()
+
+    def get_counter(self, name: str, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._counters.get((name, _label_str(labels)), 0.0)
+
+    def get_gauge(self, name: str, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._gauges.get((name, _label_str(labels)), 0.0)
+
+    def quantile(self, name: str, q: float, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            hist = self._hists.get((name, _label_str(labels)))
+            return hist.quantile(q) if hist else 0.0
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            for (name, labels), value in sorted(self._counters.items()):
+                lines.append(f"{name}_total{labels} {value}")
+            for (name, labels), value in sorted(self._gauges.items()):
+                lines.append(f"{name}{labels} {value}")
+            for (name, labels), hist in sorted(self._hists.items()):
+                lines.append(f"{name}_count{labels} {hist.count}")
+                lines.append(f"{name}_sum{labels} {hist.total}")
+                for q in (0.5, 0.9, 0.99):
+                    sub = labels[:-1] + "," if labels else "{"
+                    lines.append(f'{name}{sub}quantile="{q}"}} {hist.quantile(q)}')
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: Process-global registry used by all components.
+metrics = MetricsRegistry()

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference system is verified by running pipelines against sample
+media (SURVEY.md §4); it ships no tests. We build the pyramid ourselves
+and make the full serving path runnable without TPU hardware by forcing
+the JAX CPU platform with 8 virtual devices, so multi-chip sharding
+(Mesh/pjit paths) is exercised in every CI run.
+
+Must set XLA_FLAGS/JAX_PLATFORMS before jax initializes a backend —
+hence the top-of-conftest placement.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,40 @@
+import os
+
+from evam_tpu.config import Settings, interpolate_env, interpolate_tree
+
+
+def test_settings_defaults():
+    s = Settings()
+    assert s.rest_port == 8080
+    assert s.rtsp_port == 8554
+    assert s.run_mode == "EVA"
+    assert s.tpu.max_batch == 64
+
+
+def test_settings_from_env(monkeypatch):
+    monkeypatch.setenv("RUN_MODE", "EII")
+    monkeypatch.setenv("DETECTION_DEVICE", "cpu")
+    monkeypatch.setenv("ENABLE_RTSP", "true")
+    monkeypatch.setenv("EVAM_MAX_BATCH", "16")
+    s = Settings.from_env()
+    assert s.run_mode == "EII"
+    assert s.detection_device == "cpu"
+    assert s.enable_rtsp is True
+    assert s.tpu.max_batch == 16
+
+
+def test_settings_file_then_env_override(tmp_path, monkeypatch):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text('{"rest_port": 9090, "run_mode": "EII"}')
+    monkeypatch.setenv("RUN_MODE", "EVA")
+    s = Settings.from_env(cfg)
+    assert s.rest_port == 9090
+    assert s.run_mode == "EVA"  # env wins over file
+
+
+def test_interpolate_env(monkeypatch):
+    monkeypatch.setenv("DETECTION_DEVICE", "tpu")
+    assert interpolate_env("{env[DETECTION_DEVICE]}") == "tpu"
+    assert interpolate_env("{env[NOT_SET_ANYWHERE_42]}") == ""
+    tree = {"a": ["{env[DETECTION_DEVICE]}", 3], "b": {"c": "x"}}
+    assert interpolate_tree(tree) == {"a": ["tpu", 3], "b": {"c": "x"}}

@@ -1,0 +1,171 @@
+from pathlib import Path
+
+import pytest
+
+from evam_tpu.graph import (
+    ParameterError,
+    PipelineLoader,
+    StageKind,
+    resolve_parameters,
+)
+from evam_tpu.graph.gst_compat import parse_template
+from evam_tpu.graph.loader import parse_pipeline_json
+
+REPO = Path(__file__).resolve().parent.parent
+
+# A reference-style GStreamer template (same grammar as reference
+# pipelines/object_tracking/person_vehicle_bike/pipeline.json:3-8),
+# used to verify the compat parser without depending on the reference
+# checkout at test time.
+GST_TEMPLATE = [
+    "{auto_source} ! decodebin",
+    " ! gvadetect model={models[object_detection][person_vehicle_bike][network]} name=detection",
+    " ! gvatrack name=tracking",
+    " ! gvaclassify model={models[object_classification][vehicle_attributes][network]} name=classification",
+    " ! gvametaconvert name=metaconvert ! gvametapublish name=destination",
+    " ! appsink name=appsink",
+]
+
+
+def test_gst_compat_parses_full_chain():
+    stages = parse_template(GST_TEMPLATE)
+    kinds = [s.kind for s in stages]
+    assert kinds == [
+        StageKind.SOURCE,
+        StageKind.DECODE,
+        StageKind.DETECT,
+        StageKind.TRACK,
+        StageKind.CLASSIFY,
+        StageKind.METACONVERT,
+        StageKind.PUBLISH,
+        StageKind.SINK,
+    ]
+    det = stages[2]
+    assert det.name == "detection"
+    assert det.model == "object_detection/person_vehicle_bike"
+    cls = stages[4]
+    assert cls.model == "object_classification/vehicle_attributes"
+
+
+def test_gst_compat_caps_and_props():
+    stages = parse_template(
+        "{auto_source} ! decodebin ! videoconvert ! video/x-raw,format=BGRx"
+        " ! gvadetect model={models[a][b][network]} name=d threshold=0.5"
+        " inference-interval=3 ! appsink name=destination"
+    )
+    caps = [s for s in stages if s.properties.get("caps")][0]
+    assert caps.properties["format"] == "BGRx"
+    det = [s for s in stages if s.kind == StageKind.DETECT][0]
+    assert det.properties["threshold"] == 0.5
+    assert det.properties["inference-interval"] == 3
+
+
+def test_gst_compat_audio_caps():
+    stages = parse_template(
+        "{auto_source} ! decodebin ! audioresample ! audioconvert"
+        " ! audio/x-raw, channels=1,format=S16LE,rate=16000 ! audiomixer name=mix"
+        " ! level name=level ! gvaaudiodetect model={models[audio_detection][environment][network]}"
+        " name=detection ! appsink"
+    )
+    caps = [s for s in stages if s.properties.get("caps") == "audio/x-raw"][0]
+    assert caps.properties["rate"] == 16000
+    assert caps.properties["channels"] == 1
+    assert any(s.kind == StageKind.AUDIO_DETECT for s in stages)
+
+
+def test_loader_loads_all_native_pipelines():
+    loader = PipelineLoader(REPO / "pipelines")
+    names = loader.names()
+    expected = {
+        ("object_detection", "person_vehicle_bike"),
+        ("object_detection", "person"),
+        ("object_detection", "vehicle"),
+        ("object_detection", "object_zone_count"),
+        ("object_detection", "app_src_dst"),
+        ("object_classification", "vehicle_attributes"),
+        ("object_tracking", "person_vehicle_bike"),
+        ("object_tracking", "object_line_crossing"),
+        ("action_recognition", "general"),
+        ("audio_detection", "environment"),
+        ("video_decode", "app_dst"),
+    }
+    assert expected <= set(names)
+    for spec in loader:
+        assert spec.validate() == []
+
+
+def test_gstreamer_pipeline_json_compat():
+    data = {
+        "type": "GStreamer",
+        "template": GST_TEMPLATE,
+        "description": "compat",
+        "parameters": {"type": "object", "properties": {}},
+    }
+    spec = parse_pipeline_json(data, "object_tracking", "person_vehicle_bike")
+    assert spec.validate() == []
+    assert spec.stage("tracking").kind == StageKind.TRACK
+
+
+def test_parameter_binding_forms(monkeypatch):
+    monkeypatch.setenv("DETECTION_DEVICE", "tpu")
+    loader = PipelineLoader(REPO / "pipelines")
+    spec = loader.get("object_classification", "vehicle_attributes")
+
+    stages, _ = resolve_parameters(
+        spec,
+        {
+            "inference-interval": 5,  # multi-element binding
+            "detection-threshold": 0.7,  # named property binding
+            "detection-properties": {"ie-config": "x"},  # element-properties
+        },
+    )
+    det = [s for s in stages if s.name == "detection"][0]
+    cls = [s for s in stages if s.name == "classification"][0]
+    assert det.properties["inference-interval"] == 5
+    assert cls.properties["inference-interval"] == 5
+    assert det.properties["threshold"] == 0.7
+    assert det.properties["ie-config"] == "x"
+    # defaults: device from env, object-class literal
+    assert det.properties["device"] == "tpu"
+    assert cls.properties["object-class"] == "vehicle"
+
+
+def test_parameter_json_format_binding():
+    loader = PipelineLoader(REPO / "pipelines")
+    spec = loader.get("object_detection", "object_zone_count")
+    zones = {"zones": [{"name": "z1", "polygon": [[0, 0], [1, 0], [1, 1]]}]}
+    stages, _ = resolve_parameters(spec, {"object-zone-count-config": zones})
+    udf = [s for s in stages if s.name == "object-zone-count"][0]
+    assert udf.properties["kwarg"] == zones
+
+
+def test_parameter_validation_errors():
+    loader = PipelineLoader(REPO / "pipelines")
+    spec = loader.get("object_detection", "person_vehicle_bike")
+    with pytest.raises(ParameterError):
+        resolve_parameters(spec, {"threshold": "high"})  # wrong type
+    with pytest.raises(ParameterError):
+        resolve_parameters(spec, {"no-such-param": 1})  # unknown
+
+    # bool is not an integer
+    with pytest.raises(ParameterError):
+        resolve_parameters(spec, {"inference-interval": True})
+
+
+def test_pipeline_level_unbound_parameter():
+    loader = PipelineLoader(REPO / "pipelines")
+    spec = loader.get("audio_detection", "environment")
+    _, pipeline_level = resolve_parameters(spec, {"bus-messages": True})
+    assert pipeline_level["bus-messages"] is True
+
+
+def test_compat_against_reference_checkout():
+    """When the reference checkout is present, every one of its pipeline
+    definitions must parse through the compat path unmodified."""
+    ref = Path("/root/reference/pipelines")
+    if not ref.exists():
+        pytest.skip("reference checkout not available")
+    loader = PipelineLoader(ref)
+    assert len(loader.names()) >= 9
+    for spec in loader:
+        assert spec.validate() == []

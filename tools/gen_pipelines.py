@@ -1,0 +1,332 @@
+"""Generate the native-format pipeline definitions under pipelines/.
+
+Mirrors the reference's 6 workload families and 11 variants
+(SURVEY.md §2c) in evam_tpu's native stage-list format. Run from repo
+root: ``python tools/gen_pipelines.py``.
+"""
+
+import json
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent / "pipelines"
+
+
+def src():
+    return {"kind": "source", "name": "source"}
+
+
+def dec():
+    return {"kind": "decode", "name": "decode"}
+
+
+def detect(model="object_detection/person_vehicle_bike", **props):
+    d = {"kind": "detect", "name": "detection", "model": model}
+    if props:
+        d["properties"] = props
+    return d
+
+
+def meta_chain():
+    return [
+        {"kind": "metaconvert", "name": "metaconvert"},
+        {"kind": "publish", "name": "destination"},
+        {"kind": "sink", "name": "appsink"},
+    ]
+
+
+def params(**props):
+    return {"type": "object", "properties": props}
+
+
+DETECTION_COMMON = dict(
+    (
+        ("detection-properties", {"element": {"name": "detection", "format": "element-properties"}}),
+        ("detection-device", {"element": {"name": "detection", "property": "device"}, "type": "string", "default": "{env[DETECTION_DEVICE]}"}),
+        ("detection-model-instance-id", {"element": {"name": "detection", "property": "model-instance-id"}, "type": "string"}),
+        ("inference-interval", {"element": "detection", "type": "integer"}),
+        ("threshold", {"element": "detection", "type": "number"}),
+    )
+)
+
+CLASSIFY_COMMON = dict(
+    (
+        ("classification-properties", {"element": {"name": "classification", "format": "element-properties"}}),
+        ("classification-device", {"element": {"name": "classification", "property": "device"}, "type": "string", "default": "{env[CLASSIFICATION_DEVICE]}"}),
+        ("classification-model-instance-id", {"element": {"name": "classification", "property": "model-instance-id"}, "type": "string"}),
+        ("object-class", {"element": "classification", "type": "string", "default": "vehicle"}),
+        ("reclassify-interval", {"element": "classification", "type": "integer"}),
+    )
+)
+
+
+PIPELINES = {}
+
+# -- object_detection (5 variants; reference pipelines/object_detection/*) --
+PIPELINES[("object_detection", "person_vehicle_bike")] = {
+    "type": "tpu",
+    "description": "Person Vehicle Bike Detection (TPU batched engine)",
+    "stages": [src(), dec(), detect(), *meta_chain()],
+    "parameters": params(**DETECTION_COMMON),
+}
+
+PIPELINES[("object_detection", "person")] = {
+    "type": "tpu",
+    "description": "Person Detection (TPU batched engine)",
+    "stages": [src(), dec(), detect("object_detection/person"), *meta_chain()],
+    "parameters": params(
+        **{k: DETECTION_COMMON[k] for k in ("detection-properties", "detection-device")}
+    ),
+}
+
+PIPELINES[("object_detection", "vehicle")] = {
+    "type": "tpu",
+    "description": "Vehicle Detection based on vehicle-detection-0202 (TPU batched engine)",
+    "stages": [src(), dec(), detect("object_detection/vehicle"), *meta_chain()],
+    "parameters": params(**DETECTION_COMMON),
+}
+
+PIPELINES[("object_detection", "object_zone_count")] = {
+    "type": "tpu",
+    "description": "Detection with zone-count spatial-analytics UDF",
+    "stages": [
+        src(),
+        dec(),
+        detect(),
+        {
+            "kind": "udf",
+            "name": "object-zone-count",
+            "properties": {
+                "class": "ObjectZoneCount",
+                "module": "evam_tpu.extensions.object_zone_count",
+            },
+        },
+        {"kind": "metaconvert", "name": "metaconvert"},
+        {
+            "kind": "udf",
+            "name": "event-convert",
+            "properties": {"module": "evam_tpu.extensions.event_convert"},
+        },
+        {"kind": "publish", "name": "destination"},
+        {"kind": "sink", "name": "appsink"},
+    ],
+    "parameters": params(
+        **DETECTION_COMMON,
+        **{
+            "object-zone-count-config": {
+                "element": {"name": "object-zone-count", "property": "kwarg", "format": "json"},
+                "type": "object",
+                "properties": {
+                    "zones": {"type": "array", "items": {"type": "object"}},
+                    "enable_watermark": {"type": "boolean"},
+                    "log_level": {"type": "string"},
+                },
+            }
+        },
+    ),
+}
+
+PIPELINES[("object_detection", "app_src_dst")] = {
+    "type": "tpu",
+    "description": "Detection with app source and raw appsink destination",
+    "stages": [src(), dec(), detect(), {"kind": "sink", "name": "destination"}],
+    "parameters": params(
+        **{"detection-model-instance-id": DETECTION_COMMON["detection-model-instance-id"]}
+    ),
+}
+
+# -- object_classification ------------------------------------------------
+PIPELINES[("object_classification", "vehicle_attributes")] = {
+    "type": "tpu",
+    "description": "Detection + Vehicle Attributes Classification (TPU)",
+    "stages": [
+        src(),
+        dec(),
+        detect(),
+        {
+            "kind": "classify",
+            "name": "classification",
+            "model": "object_classification/vehicle_attributes",
+        },
+        *meta_chain(),
+    ],
+    "parameters": params(
+        **CLASSIFY_COMMON,
+        **{k: DETECTION_COMMON[k] for k in ("detection-properties", "detection-device", "detection-model-instance-id")},
+        **{
+            "inference-interval": {
+                "element": [
+                    {"name": "detection", "property": "inference-interval"},
+                    {"name": "classification", "property": "inference-interval"},
+                ],
+                "type": "integer",
+            },
+            "detection-threshold": {
+                "element": {"name": "detection", "property": "threshold"},
+                "type": "number",
+            },
+            "classification-threshold": {
+                "element": {"name": "classification", "property": "threshold"},
+                "type": "number",
+            },
+        },
+    ),
+}
+
+# -- object_tracking (2 variants) -----------------------------------------
+_track_stage = {"kind": "track", "name": "tracking"}
+
+PIPELINES[("object_tracking", "person_vehicle_bike")] = {
+    "type": "tpu",
+    "description": "Detection + Tracking + Vehicle Attributes Classification (TPU)",
+    "stages": [
+        src(),
+        dec(),
+        detect(),
+        dict(_track_stage),
+        {
+            "kind": "classify",
+            "name": "classification",
+            "model": "object_classification/vehicle_attributes",
+        },
+        *meta_chain(),
+    ],
+    "parameters": params(
+        **CLASSIFY_COMMON,
+        **{k: DETECTION_COMMON[k] for k in ("detection-properties", "detection-device", "detection-model-instance-id")},
+        **{
+            "tracking-properties": {"element": {"name": "tracking", "format": "element-properties"}},
+            "tracking-device": {"element": [{"name": "tracking", "property": "device"}], "type": "string"},
+            "tracking-type": {"element": {"name": "tracking", "property": "tracking-type"}, "type": "string", "default": "iou"},
+            "inference-interval": {
+                "element": [
+                    {"name": "detection", "property": "inference-interval"},
+                    {"name": "classification", "property": "inference-interval"},
+                ],
+                "type": "integer",
+            },
+            "detection-threshold": {"element": {"name": "detection", "property": "threshold"}, "type": "number"},
+            "classification-threshold": {"element": {"name": "classification", "property": "threshold"}, "type": "number"},
+        },
+    ),
+}
+
+PIPELINES[("object_tracking", "object_line_crossing")] = {
+    "type": "tpu",
+    "description": "Detection + Tracking with line-crossing spatial-analytics UDF",
+    "stages": [
+        src(),
+        dec(),
+        detect(),
+        dict(_track_stage),
+        {
+            "kind": "udf",
+            "name": "object-line-crossing",
+            "properties": {
+                "class": "ObjectLineCrossing",
+                "module": "evam_tpu.extensions.object_line_crossing",
+            },
+        },
+        {"kind": "metaconvert", "name": "metaconvert"},
+        {
+            "kind": "udf",
+            "name": "event-convert",
+            "properties": {"module": "evam_tpu.extensions.event_convert"},
+        },
+        {"kind": "publish", "name": "destination"},
+        {"kind": "sink", "name": "appsink"},
+    ],
+    "parameters": params(
+        **DETECTION_COMMON,
+        **{
+            "tracking-properties": {"element": {"name": "tracking", "format": "element-properties"}},
+            "object-line-crossing-config": {
+                "element": {"name": "object-line-crossing", "property": "kwarg", "format": "json"},
+                "type": "object",
+                "properties": {
+                    "lines": {"type": "array", "items": {"type": "object"}},
+                    "enable_watermark": {"type": "boolean"},
+                    "log_level": {"type": "string"},
+                },
+            },
+        },
+    ),
+}
+
+# -- action_recognition ---------------------------------------------------
+PIPELINES[("action_recognition", "general")] = {
+    "type": "tpu",
+    "description": "General action recognition, 16-frame clip encoder+decoder (TPU)",
+    "stages": [
+        src(),
+        dec(),
+        {"kind": "convert", "name": "convert", "properties": {"caps": "video/x-raw", "format": "BGRx"}},
+        {
+            "kind": "action",
+            "name": "action_recognition",
+            "properties": {
+                "enc-model": "action_recognition/encoder",
+                "dec-model": "action_recognition/decoder",
+                "model-proc": "action_recognition/decoder",
+            },
+        },
+        {"kind": "metaconvert", "name": "metaconvert", "properties": {"add-tensor-data": True}},
+        {"kind": "publish", "name": "destination"},
+        {"kind": "sink", "name": "appsink"},
+    ],
+    "parameters": params(
+        **{
+            "enc-device": {"element": "action_recognition", "description": "Encoder inference device: [CPU, GPU, TPU]", "type": "string", "default": "{env[DETECTION_DEVICE]}"},
+            "dec-device": {"element": "action_recognition", "description": "Decoder inference device: [CPU, GPU, TPU]", "type": "string", "default": "{env[DETECTION_DEVICE]}"},
+            "action-recognition-properties": {"element": {"name": "action_recognition", "format": "element-properties"}},
+        }
+    ),
+}
+
+# -- audio_detection ------------------------------------------------------
+PIPELINES[("audio_detection", "environment")] = {
+    "type": "tpu",
+    "description": "Environmental sound detection based on AclNet (TPU)",
+    "stages": [
+        src(),
+        dec(),
+        {
+            "kind": "convert",
+            "name": "audio_format",
+            "properties": {"caps": "audio/x-raw", "channels": 1, "format": "S16LE", "rate": 16000},
+        },
+        {"kind": "audio_mix", "name": "audiomixer"},
+        {"kind": "level", "name": "level"},
+        {"kind": "audio_detect", "name": "detection", "model": "audio_detection/environment"},
+        *meta_chain(),
+    ],
+    "parameters": params(
+        **{
+            "device": {"element": "detection", "type": "string", "default": "{env[DETECTION_DEVICE]}"},
+            "bus-messages": {"description": "Log bus messages as info", "type": "boolean", "default": False},
+            "output-buffer-duration": {"element": "audiomixer", "type": "integer", "default": 100000000},
+            "threshold": {"element": "detection", "type": "number"},
+            "sliding-window": {"element": "detection", "type": "number", "default": 0.2},
+            "post-messages": {"element": "level", "type": "boolean"},
+            "detection-properties": {"element": {"name": "detection", "format": "element-properties"}},
+        }
+    ),
+}
+
+# -- video_decode ---------------------------------------------------------
+PIPELINES[("video_decode", "app_dst")] = {
+    "type": "tpu",
+    "description": "Decode-only pipeline with appsink destination",
+    "stages": [src(), dec(), {"kind": "sink", "name": "destination"}],
+}
+
+
+def main():
+    for (name, version), spec in PIPELINES.items():
+        path = ROOT / name / version / "pipeline.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(spec, indent=2) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
