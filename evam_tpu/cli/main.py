@@ -1,0 +1,84 @@
+"""evam-tpu command line: serve / fetch-models / bench / list.
+
+The single CLI replacing the reference's RUN_MODE shell dispatch
+(reference run.sh:26-30): ``serve`` starts the REST (EVA-equivalent)
+or msgbus (EII-equivalent) frontend per settings; ``fetch-models``
+is the model_downloader counterpart (reference
+tools/model_downloader/model_downloader.sh:24-32).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from evam_tpu.config import get_settings
+from evam_tpu.obs import configure_logging, get_logger
+
+log = get_logger("cli")
+
+
+def cmd_list(args) -> int:
+    from evam_tpu.graph import PipelineLoader
+    from evam_tpu.models import ModelRegistry
+
+    settings = get_settings()
+    loader = PipelineLoader(settings.pipelines_dir)
+    print(json.dumps(
+        {
+            "pipelines": [f"{n}/{v}" for n, v in loader.names()],
+            "models": ModelRegistry(settings.models_dir).keys(),
+        },
+        indent=2,
+    ))
+    return 0
+
+
+def cmd_fetch_models(args) -> int:
+    from evam_tpu.models.fetch import fetch_models
+
+    return fetch_models(
+        model_list=args.model_list, output=args.output, force=args.force
+    )
+
+
+def cmd_serve(args) -> int:
+    settings = get_settings()
+    mode = (args.mode or settings.run_mode).upper()
+    if mode == "EII":
+        from evam_tpu.eii.manager import run_eii_service
+
+        return run_eii_service(settings)
+    from evam_tpu.server.app import run_server
+
+    return run_server(settings)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="evam-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("serve", help="start the serving frontend")
+    s.add_argument("--mode", choices=["EVA", "EII", "eva", "eii"], default=None)
+    s.set_defaults(fn=cmd_serve)
+
+    f = sub.add_parser("fetch-models", help="materialize the model directory")
+    f.add_argument("--model-list", default="models_list/models.list.yml")
+    f.add_argument("--output", default="models")
+    f.add_argument("--force", action="store_true")
+    f.set_defaults(fn=cmd_fetch_models)
+
+    ls = sub.add_parser("list", help="list pipelines and models")
+    ls.set_defaults(fn=cmd_list)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    configure_logging()
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

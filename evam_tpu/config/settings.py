@@ -1,4 +1,8 @@
-"""Service settings: env < file < pipeline default < request override.
+"""Service settings: file < env < pipeline default < request override.
+
+(Env beats the config file — operators override a deployed file with
+container env vars, matching the reference's compose-driven env
+surface.)
 
 Covers the reference's three config tiers (SURVEY.md §5.6):
   (a) env vars — RUN_MODE (reference run.sh:26), DETECTION_DEVICE /

@@ -1,0 +1,3 @@
+from evam_tpu.modelproc.proc import ModelProc, load_model_proc
+
+__all__ = ["ModelProc", "load_model_proc"]

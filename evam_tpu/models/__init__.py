@@ -1,0 +1,3 @@
+from evam_tpu.models.registry import ModelRegistry, LoadedModel, ModelSpec, ZOO_SPECS
+
+__all__ = ["ModelRegistry", "LoadedModel", "ModelSpec", "ZOO_SPECS"]

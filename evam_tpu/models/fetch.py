@@ -1,0 +1,142 @@
+"""`fetch-models`: materialize the serving model directory.
+
+Counterpart of the reference's model downloader (reference
+tools/model_downloader/downloader.py:275-296): reads a YAML model
+list (same schema: model/alias/version/precision/model-proc —
+reference models_list/models.list.yml), validates it, and produces
+the serving layout ``models/{alias}/{version}/{precision}/``.
+
+Where the reference shells out to OMZ ``omz_downloader``/
+``omz_converter`` (network + OpenVINO), this tool exports the
+built-in JAX zoo's weights (deterministic init when no trained
+weights are available — this image has no egress) and writes default
+model-proc JSONs. Dropping trained ``weights.msgpack`` files into the
+same layout upgrades a model in place without code changes.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from evam_tpu.models.registry import ModelRegistry, ZOO_SPECS
+from evam_tpu.modelproc.proc import dump_model_proc
+from evam_tpu.obs import get_logger
+
+log = get_logger("models.fetch")
+
+_ALLOWED_PRECISIONS = {"FP32", "FP16", "BF16", "INT8", "FP16-INT8", "FP32-INT8"}
+
+
+class ModelListError(ValueError):
+    pass
+
+
+def parse_model_list(path: str | Path) -> list[dict]:
+    """Parse and validate the models.list.yml schema.
+
+    Schema mirrors reference tools/model_downloader/mdt_schema.py:7-34:
+    each entry is a model name or a mapping with required ``model`` and
+    optional alias/version/precision/model-proc. Implemented without a
+    yaml dependency (the list format is a flat subset of YAML).
+    """
+    entries: list[dict] = []
+    current: dict | None = None
+    for lineno, raw in enumerate(Path(path).read_text().splitlines(), 1):
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        if line.startswith("- "):
+            if current:
+                entries.append(current)
+            current = {}
+            line = line[2:].strip()
+            if line and ":" not in line:
+                current["model"] = line
+                continue
+        elif current is None:
+            raise ModelListError(f"{path}:{lineno}: expected list item")
+        else:
+            line = line.strip()
+        if not line:
+            continue
+        key, _, value = line.partition(":")
+        value = value.strip()
+        if value.startswith("[") and value.endswith("]"):
+            parsed = [v.strip() for v in value[1:-1].split(",") if v.strip()]
+        else:
+            parsed = value
+        current[key.strip()] = parsed
+    if current:
+        entries.append(current)
+
+    for e in entries:
+        if "model" not in e or not e["model"]:
+            raise ModelListError(f"entry missing required 'model': {e}")
+        precisions = e.get("precision", ["FP32"])
+        if isinstance(precisions, str):
+            precisions = [precisions]
+        bad = set(precisions) - _ALLOWED_PRECISIONS
+        if bad:
+            raise ModelListError(f"{e['model']}: invalid precisions {sorted(bad)}")
+        e["precision"] = precisions
+        # reference defaults: alias=model name, version=1
+        # (tools/model_downloader/downloader.py:190-212)
+        e.setdefault("alias", e["model"])
+        e.setdefault("version", "1")
+    return entries
+
+
+def _zoo_key_for(entry: dict) -> str | None:
+    key = f"{entry['alias']}/{entry['version']}"
+    if key in ZOO_SPECS:
+        return key
+    for k, s in ZOO_SPECS.items():
+        if s.omz_name == entry["model"]:
+            return k
+    return None
+
+
+def fetch_models(
+    model_list: str | Path,
+    output: str | Path,
+    force: bool = False,
+    dtype: str = "float32",
+) -> int:
+    entries = parse_model_list(model_list)
+    out_root = Path(output)
+    failures = 0
+    for entry in entries:
+        key = _zoo_key_for(entry)
+        if key is None:
+            log.error("no zoo model for manifest entry %s", entry["model"])
+            failures += 1
+            continue
+        spec = ZOO_SPECS[key]
+        target = out_root / entry["alias"] / str(entry["version"])
+        for precision in entry["precision"]:
+            wpath = target / precision / "weights.msgpack"
+            if wpath.exists() and not force:
+                log.info("%s exists, skipping (use force=True)", wpath)
+                continue
+            reg = ModelRegistry(models_dir=out_root, precision=precision,
+                                dtype="bfloat16" if precision == "BF16" else dtype)
+            reg.save_weights(key, out_root)
+            # save_weights writes under the zoo key; move if aliased
+            src = out_root / key / precision / "weights.msgpack"
+            if src != wpath:
+                wpath.parent.mkdir(parents=True, exist_ok=True)
+                src.replace(wpath)
+            log.info("materialized %s", wpath)
+        proc_path = target / f"{entry['model']}.json"
+        if not proc_path.exists() or force:
+            proc_path.parent.mkdir(parents=True, exist_ok=True)
+            head_labels = dict(spec.head_labels)
+            if head_labels:
+                name, labels_ = next(iter(head_labels.items()))
+                proc = dump_model_proc(list(labels_), attribute_name=name)
+            else:
+                proc = dump_model_proc(list(spec.labels))
+            proc_path.write_text(json.dumps(proc, indent=2) + "\n")
+    log.info("fetched %d manifest entries (%d failures)", len(entries), failures)
+    return 1 if failures else 0

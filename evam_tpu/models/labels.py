@@ -1,0 +1,33 @@
+"""Default label sets for the built-in model zoo.
+
+These are the public label vocabularies of the corresponding Open
+Model Zoo models (documented model outputs; the reference ships them
+via model-proc JSON, e.g. models_list/vehicle-detection-0202.json:4-10).
+A user-provided model-proc file overrides these defaults
+(evam_tpu.modelproc).
+
+Index 0 is background for detector label spaces — the reference's
+published metadata uses label_id 2 = "vehicle"
+(charts/README.md:117), implying the background-at-0 convention.
+"""
+
+from __future__ import annotations
+
+PERSON_VEHICLE_BIKE = ["background", "person", "vehicle", "bike"]
+PERSON = ["background", "person"]
+VEHICLE = ["background", "vehicle"]
+FACE = ["background", "face"]
+
+# vehicle-attributes-recognition-barrier-0039 documented outputs.
+VEHICLE_COLORS = ["white", "gray", "yellow", "red", "green", "blue", "black"]
+VEHICLE_TYPES = ["car", "bus", "truck", "van"]
+
+# emotions-recognition-retail-0003 documented outputs.
+EMOTIONS = ["neutral", "happy", "sad", "surprise", "anger"]
+
+# Placeholder 400-way action vocabulary; a Kinetics-400 model-proc
+# file (as the reference ships) replaces these names at load time.
+ACTIONS_400 = [f"action_{i:03d}" for i in range(400)]
+
+# Placeholder 53-way audio event vocabulary (AclNet output arity).
+AUDIO_EVENTS = [f"sound_{i:02d}" for i in range(53)]

@@ -1,0 +1,301 @@
+"""Model registry: ``alias/version`` → built, ready-to-jit model.
+
+Serves the same role as the reference's model directory contract
+(``models/{alias}/{version}/{precision}/*.xml|.bin``, reference
+README.md:44-52, consumed by templates as
+``{models[alias][version][network]}``) but TPU-native:
+
+* weights live as flax msgpack under the same directory layout
+  (``weights.msgpack`` instead of IR ``.xml/.bin``);
+* a missing weights file yields deterministic random-init weights so
+  the full serving path runs hermetically (no-egress CI, SURVEY.md §4
+  fake-backend requirement);
+* an adjacent model-proc JSON (same schema as the reference's,
+  models_list/*.json) overrides preprocessing and labels.
+
+Each LoadedModel exposes a pure ``forward`` suitable for `jax.jit` /
+`pjit`; the engine owns batching, sharding and dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from evam_tpu.models import labels as L
+from evam_tpu.models.zoo.aclnet import AclNet, WINDOW_SAMPLES
+from evam_tpu.models.zoo.action import ActionRecognizer, ActionEncoder, ActionDecoder, CLIP_LEN
+from evam_tpu.models.zoo.classifier import MultiHeadClassifier
+from evam_tpu.models.zoo.ssd import SSDDetector
+from evam_tpu.modelproc import ModelProc, load_model_proc
+from evam_tpu.obs import get_logger
+from evam_tpu.ops.preprocess import PreprocessSpec
+
+log = get_logger("models.registry")
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    key: str                     # "alias/version"
+    family: str                  # ssd | classifier | action | aclnet
+    input_size: tuple[int, int]  # (H, W) — or (1, samples) for audio
+    num_classes: int = 0
+    heads: tuple[tuple[str, int], ...] = ()
+    width: int = 32
+    labels: tuple[str, ...] = ()
+    head_labels: tuple[tuple[str, tuple[str, ...]], ...] = ()
+    #: corresponding reference/OMZ model name (parity bookkeeping)
+    omz_name: str = ""
+
+
+def _spec(key, family, size, **kw):
+    return ModelSpec(key=key, family=family, input_size=size, **kw)
+
+
+#: Built-in zoo mirroring the reference's 8-model manifest
+#: (reference models_list/models.list.yml:1-34).
+ZOO_SPECS: dict[str, ModelSpec] = {
+    s.key: s
+    for s in [
+        _spec(
+            "object_detection/person_vehicle_bike", "ssd", (512, 512),
+            num_classes=4, labels=tuple(L.PERSON_VEHICLE_BIKE),
+            omz_name="person-vehicle-bike-detection-crossroad-0078",
+        ),
+        _spec(
+            "object_detection/person", "ssd", (320, 544),
+            num_classes=2, labels=tuple(L.PERSON),
+            omz_name="person-detection-retail-0013",
+        ),
+        _spec(
+            "object_detection/vehicle", "ssd", (512, 512),
+            num_classes=2, labels=tuple(L.VEHICLE),
+            omz_name="vehicle-detection-0202",
+        ),
+        _spec(
+            "face_detection_retail/1", "ssd", (300, 300),
+            num_classes=2, labels=tuple(L.FACE),
+            omz_name="face-detection-retail-0004",
+        ),
+        _spec(
+            "object_classification/vehicle_attributes", "classifier", (72, 72),
+            heads=(("color", 7), ("type", 4)),
+            head_labels=(
+                ("color", tuple(L.VEHICLE_COLORS)),
+                ("type", tuple(L.VEHICLE_TYPES)),
+            ),
+            omz_name="vehicle-attributes-recognition-barrier-0039",
+        ),
+        _spec(
+            "emotion_recognition/1", "classifier", (64, 64),
+            heads=(("emotion", 5),),
+            head_labels=(("emotion", tuple(L.EMOTIONS)),),
+            omz_name="emotions-recognition-retail-0003",
+        ),
+        _spec(
+            "action_recognition/encoder", "action_encoder", (224, 224),
+            num_classes=400, labels=tuple(L.ACTIONS_400),
+            omz_name="action-recognition-0001-encoder",
+        ),
+        _spec(
+            "action_recognition/decoder", "action_decoder", (224, 224),
+            num_classes=400, labels=tuple(L.ACTIONS_400),
+            omz_name="action-recognition-0001-decoder",
+        ),
+        _spec(
+            "audio_detection/environment", "aclnet", (1, WINDOW_SAMPLES),
+            num_classes=53, labels=tuple(L.AUDIO_EVENTS),
+            omz_name="aclnet",
+        ),
+    ]
+}
+
+
+@dataclass
+class LoadedModel:
+    spec: ModelSpec
+    module: Any
+    params: Any
+    preprocess: PreprocessSpec
+    model_proc: ModelProc | None = None
+    labels: list[str] = field(default_factory=list)
+    head_labels: dict[str, list[str]] = field(default_factory=dict)
+    anchors: np.ndarray | None = None
+
+    @property
+    def forward(self) -> Callable:
+        """Pure apply: (params, batch) → raw outputs."""
+        module = self.module
+
+        def fn(params, batch):
+            return module.apply({"params": params}, batch)
+
+        return fn
+
+
+def build_module(spec: ModelSpec, overrides: dict[str, Any] | None = None):
+    cfg = dict(overrides or {})
+    width = cfg.get("width", spec.width)
+    if spec.family == "ssd":
+        return SSDDetector(num_classes=spec.num_classes, width=width)
+    if spec.family == "classifier":
+        return MultiHeadClassifier(heads=spec.heads, width=width)
+    if spec.family == "action_encoder":
+        return ActionEncoder(width=width)
+    if spec.family == "action_decoder":
+        return ActionDecoder(num_classes=spec.num_classes)
+    if spec.family == "action":
+        return ActionRecognizer(num_classes=spec.num_classes)
+    if spec.family == "aclnet":
+        return AclNet(num_classes=spec.num_classes, width=width)
+    raise ValueError(f"unknown model family {spec.family!r}")
+
+
+def _example_input(spec: ModelSpec) -> jnp.ndarray:
+    h, w = spec.input_size
+    if spec.family == "aclnet":
+        return jnp.zeros((1, w), jnp.float32)
+    if spec.family == "action_decoder":
+        return jnp.zeros((1, CLIP_LEN, 512), jnp.float32)
+    if spec.family == "action":
+        return jnp.zeros((1, CLIP_LEN, h, w, 3), jnp.float32)
+    return jnp.zeros((1, h, w, 3), jnp.float32)
+
+
+def _seed_for(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:4], "little")
+
+
+class ModelRegistry:
+    """Builds and caches models, resolving weights/procs from disk.
+
+    ``models_dir`` follows the reference layout; ``precision`` selects
+    the weights subdirectory (FP32/FP16/BF16 — the reference downloads
+    FP16+FP32 per model, models_list/models.list.yml).
+    """
+
+    def __init__(
+        self,
+        models_dir: str | Path | None = None,
+        precision: str = "BF16",
+        dtype: str = "bfloat16",
+        input_overrides: dict[str, tuple[int, int]] | None = None,
+        width_overrides: dict[str, int] | None = None,
+    ):
+        self.models_dir = Path(models_dir) if models_dir else None
+        self.precision = precision
+        self.dtype = dtype
+        self.input_overrides = input_overrides or {}
+        self.width_overrides = width_overrides or {}
+        self._cache: dict[str, LoadedModel] = {}
+
+    def get(self, key: str) -> LoadedModel:
+        if key not in self._cache:
+            self._cache[key] = self._load(key)
+        return self._cache[key]
+
+    def keys(self) -> list[str]:
+        """Loadable model keys: the built-in zoo (on-disk weight dirs
+        only customize these; models outside the zoo need a zoo spec)."""
+        return sorted(ZOO_SPECS)
+
+    def _load(self, key: str) -> LoadedModel:
+        spec = ZOO_SPECS.get(key)
+        if spec is None:
+            raise KeyError(
+                f"unknown model '{key}' — not in the built-in zoo "
+                f"(known: {sorted(ZOO_SPECS)})"
+            )
+        if key in self.input_overrides:
+            spec = ModelSpec(**{**spec.__dict__, "input_size": self.input_overrides[key]})
+        if key in self.width_overrides:
+            spec = ModelSpec(**{**spec.__dict__, "width": self.width_overrides[key]})
+
+        module = build_module(spec)
+        params = self._init_or_load_params(spec, module)
+
+        proc = self._find_model_proc(spec)
+        model_labels = list(spec.labels)
+        if proc and proc.labels_for(0):
+            model_labels = proc.labels_for(0)
+
+        preproc = PreprocessSpec(
+            height=spec.input_size[0],
+            width=spec.input_size[1],
+            color_space="BGR",  # OMZ-era nets are BGR-native
+            dtype=self.dtype,
+        )
+        if proc:
+            preproc = proc.preprocess_spec(*spec.input_size, dtype=self.dtype)
+
+        anchors = None
+        if spec.family == "ssd":
+            anchors = module.anchors(spec.input_size)
+
+        return LoadedModel(
+            spec=spec,
+            module=module,
+            params=params,
+            preprocess=preproc,
+            model_proc=proc,
+            labels=model_labels,
+            head_labels={k: list(v) for k, v in spec.head_labels},
+            anchors=anchors,
+        )
+
+    def _weights_path(self, spec: ModelSpec) -> Path | None:
+        if not self.models_dir:
+            return None
+        base = self.models_dir / spec.key
+        for precision in (self.precision, "FP32", "FP16"):
+            p = base / precision / "weights.msgpack"
+            if p.exists():
+                return p
+        return None
+
+    def _init_or_load_params(self, spec: ModelSpec, module) -> Any:
+        rng = jax.random.PRNGKey(_seed_for(spec.key))
+        params = module.init(rng, _example_input(spec))["params"]
+        path = self._weights_path(spec)
+        if path is not None:
+            log.info("loading weights for %s from %s", spec.key, path)
+            params = serialization.from_bytes(params, path.read_bytes())
+        else:
+            log.info("no weights on disk for %s — deterministic random init", spec.key)
+        if self.dtype == "bfloat16":
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                params,
+            )
+        return params
+
+    def _find_model_proc(self, spec: ModelSpec) -> ModelProc | None:
+        if not self.models_dir:
+            return None
+        base = self.models_dir / spec.key
+        for candidate in sorted(base.glob("**/*.json")):
+            try:
+                return load_model_proc(candidate)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("bad model-proc %s: %s", candidate, exc)
+        return None
+
+    def save_weights(self, key: str, out_dir: str | Path | None = None) -> Path:
+        """Serialize current params into the models-dir layout."""
+        model = self.get(key)
+        root = Path(out_dir) if out_dir else self.models_dir
+        if root is None:
+            raise ValueError("no models_dir to save into")
+        path = root / key / self.precision / "weights.msgpack"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(serialization.to_bytes(model.params))
+        return path

@@ -1,0 +1,37 @@
+"""AclNet-style audio event classifier.
+
+Counterpart of the reference's audio_detection/environment model
+(aclnet, reference models_list/models.list.yml:9-12) consumed by
+gvaaudiodetect on 16 kHz mono S16LE windows (reference
+pipelines/audio_detection/environment/pipeline.json:4-9).
+
+1-D convolutions are expressed as 2-D convs with a singleton height so
+XLA maps them onto the MXU like any image conv.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+#: One-second analysis window at 16 kHz (gvaaudiodetect's contract).
+SAMPLE_RATE = 16000
+WINDOW_SAMPLES = 16000
+
+
+class AclNet(nn.Module):
+    num_classes: int = 53
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: float [B, S] in [-1, 1] (normalized S16LE samples)
+        w = self.width
+        x = x[:, None, :, None]  # [B, 1, S, 1] — 1-D conv as 2-D
+        for i, stride in enumerate((4, 4, 4, 4, 2)):
+            x = nn.Conv(w * (2 ** min(i, 3)), (1, 9), (1, stride), padding="SAME")(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(256)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,96 @@
+"""Action recognition: per-frame encoder + temporal decoder.
+
+Counterpart of the reference's composite gvaactionrecognitionbin
+element driving action-recognition-0001 encoder+decoder (reference
+pipelines/action_recognition/general/pipeline.json:4; composite-model
+note in that pipeline's README.md:13-19): the encoder embeds each
+frame, a 16-frame clip of embeddings goes through a temporal
+transformer decoder to per-clip class logits.
+
+TPU design: the clip axis is a second batch axis — the engine runs
+encoder on (streams × frames) batches and decoder on (streams × 1)
+clip batches inside the same jitted step family; no cross-chip
+sequence sharding is needed at clip length 16 (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from evam_tpu.models.zoo.layers import ConvBlock, SeparableConv
+
+CLIP_LEN = 16
+
+
+class ActionEncoder(nn.Module):
+    """Frame → embedding (action-recognition-0001-encoder counterpart)."""
+
+    embed_dim: int = 512
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = ConvBlock(w, strides=(2, 2))(x)
+        x = SeparableConv(w * 2, strides=(2, 2))(x)
+        x = SeparableConv(w * 4, strides=(2, 2))(x)
+        x = SeparableConv(w * 8, strides=(2, 2))(x)
+        x = SeparableConv(w * 16, strides=(2, 2))(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.embed_dim)(x)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int = 8
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(num_heads=self.heads)(h, h)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim * self.mlp_ratio)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim)(h)
+        return x + h
+
+
+class ActionDecoder(nn.Module):
+    """Clip of embeddings [B, T, D] → class logits [B, C]
+    (action-recognition-0001-decoder counterpart)."""
+
+    num_classes: int = 400
+    dim: int = 512
+    depth: int = 4
+    heads: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        t = x.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, t, self.dim)
+        )
+        x = nn.Dense(self.dim)(x) + pos
+        for _ in range(self.depth):
+            x = TransformerBlock(self.dim, self.heads)(x)
+        x = nn.LayerNorm()(x)
+        x = x.mean(axis=1)
+        return nn.Dense(self.num_classes)(x)
+
+
+class ActionRecognizer(nn.Module):
+    """Fused encoder+decoder over a full clip [B, T, H, W, 3]."""
+
+    num_classes: int = 400
+    embed_dim: int = 512
+
+    @nn.compact
+    def __call__(self, clip):
+        b, t = clip.shape[:2]
+        frames = clip.reshape((b * t,) + clip.shape[2:])
+        emb = ActionEncoder(self.embed_dim)(frames)
+        emb = emb.reshape(b, t, self.embed_dim)
+        return ActionDecoder(self.num_classes, self.embed_dim)(emb)

@@ -1,0 +1,35 @@
+"""Attribute / category classifiers.
+
+Counterparts of the reference's secondary-classification models:
+vehicle-attributes-recognition-barrier-0039 (color + type heads) and
+emotions-recognition-retail-0003 (5-way softmax); reference
+models_list/models.list.yml:5-16. Runs on ROI crops produced by the
+classify stage (the gvaclassify equivalent, SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from evam_tpu.models.zoo.layers import ConvBlock, SeparableConv
+
+
+class MultiHeadClassifier(nn.Module):
+    """Small convnet with one softmax head per attribute.
+
+    ``heads`` maps head name → number of classes, e.g.
+    ``{"color": 7, "type": 4}`` for vehicle attributes.
+    """
+
+    heads: tuple[tuple[str, int], ...]
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = ConvBlock(w, strides=(2, 2))(x)
+        x = SeparableConv(w * 2, strides=(2, 2))(x)
+        x = SeparableConv(w * 4, strides=(2, 2))(x)
+        x = SeparableConv(w * 8, strides=(2, 2))(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return {name: nn.Dense(n)(x) for name, n in self.heads}

@@ -1,0 +1,105 @@
+"""Shared conv building blocks (flax.linen).
+
+Deployment-time models carry BatchNorm folded into conv weights (the
+reference serves OpenVINO IR, where the Model Optimizer folds BN —
+SURVEY.md §2b OMZ tools row), so blocks here are conv+bias+activation:
+the exact inference-time graph, and the friendliest shape for XLA
+fusion onto the MXU.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBlock(nn.Module):
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    act: Callable = nn.relu6
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides, padding="SAME")(x)
+        return self.act(x)
+
+
+class SeparableConv(nn.Module):
+    """Depthwise separable conv (MobileNet-style)."""
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    act: Callable = nn.relu6
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch,
+            (3, 3),
+            self.strides,
+            padding="SAME",
+            feature_group_count=in_ch,
+        )(x)
+        x = self.act(x)
+        x = nn.Conv(self.features, (1, 1), padding="SAME")(x)
+        return self.act(x)
+
+
+class InvertedResidual(nn.Module):
+    """MobileNetV2-style inverted residual block."""
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    expand: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        h = nn.Conv(in_ch * self.expand, (1, 1))(x)
+        h = nn.relu6(h)
+        h = nn.Conv(
+            in_ch * self.expand,
+            (3, 3),
+            self.strides,
+            padding="SAME",
+            feature_group_count=in_ch * self.expand,
+        )(h)
+        h = nn.relu6(h)
+        h = nn.Conv(self.features, (1, 1))(h)
+        if self.strides == (1, 1) and in_ch == self.features:
+            h = h + x
+        return h
+
+
+class Backbone(nn.Module):
+    """Strided separable-conv backbone emitting multi-scale features.
+
+    Returns feature maps at strides /8, /16, /32 (+ extra /64, /128
+    levels when ``extra_levels`` > 0) — the standard SSD pyramid.
+    """
+
+    width: int = 32
+    extra_levels: int = 2
+
+    @nn.compact
+    def __call__(self, x) -> list[jnp.ndarray]:
+        w = self.width
+        x = ConvBlock(w, strides=(2, 2))(x)            # /2
+        x = SeparableConv(w * 2, strides=(2, 2))(x)    # /4
+        x = SeparableConv(w * 2)(x)
+        x = SeparableConv(w * 4, strides=(2, 2))(x)    # /8
+        c3 = SeparableConv(w * 4)(x)
+        x = SeparableConv(w * 8, strides=(2, 2))(c3)   # /16
+        c4 = SeparableConv(w * 8)(x)
+        x = SeparableConv(w * 16, strides=(2, 2))(c4)  # /32
+        c5 = SeparableConv(w * 16)(x)
+        feats = [c3, c4, c5]
+        for _ in range(self.extra_levels):
+            x = ConvBlock(w * 8, kernel=(1, 1))(feats[-1])
+            x = ConvBlock(w * 16, strides=(2, 2))(x)
+            feats.append(x)
+        return feats

@@ -1,0 +1,80 @@
+"""SSD-family detector in flax — the TPU-native counterpart of the
+reference's OpenVINO detection topologies (person-vehicle-bike-
+detection-crossroad-0078, vehicle-detection-0202, face-detection-
+retail-0004, person-detection-retail-0013; reference
+models_list/models.list.yml:1-34).
+
+The PriorBox/DetectionOutput C++ layers of those IRs become trace-time
+anchor constants plus the jittable decode/NMS in evam_tpu.ops —
+everything from raw frame to [B, K, 6] detections is one XLA program.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from evam_tpu.models.zoo.layers import Backbone
+from evam_tpu.ops.boxes import anchors_per_cell, generate_anchors
+
+
+class SSDHead(nn.Module):
+    num_anchors: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, feat):
+        b = feat.shape[0]
+        loc = nn.Conv(self.num_anchors * 4, (3, 3), padding="SAME")(feat)
+        conf = nn.Conv(self.num_anchors * self.num_classes, (3, 3), padding="SAME")(feat)
+        return (
+            loc.reshape(b, -1, 4),
+            conf.reshape(b, -1, self.num_classes),
+        )
+
+
+class SSDDetector(nn.Module):
+    """Multi-scale single-shot detector.
+
+    ``num_classes`` includes background at index 0, matching the
+    label_id convention of the reference's published metadata
+    (label_id 2 = "vehicle" in charts/README.md:117 sample output).
+    """
+
+    num_classes: int = 4
+    width: int = 32
+    extra_levels: int = 2
+    aspect_ratios: tuple[float, ...] = (1.0, 2.0, 0.5)
+
+    @nn.compact
+    def __call__(self, x):
+        feats = Backbone(self.width, self.extra_levels)(x)
+        num_anchors = anchors_per_cell(self.aspect_ratios)
+        locs, confs = [], []
+        for feat in feats:
+            loc, conf = SSDHead(num_anchors, self.num_classes)(feat)
+            locs.append(loc)
+            confs.append(conf)
+        return {
+            "loc": jnp.concatenate(locs, axis=1),
+            "conf": jnp.concatenate(confs, axis=1),
+        }
+
+    @staticmethod
+    def feature_shapes(input_size: tuple[int, int], extra_levels: int = 2):
+        # SAME-padded stride-2 convs round up, so feature sizes are
+        # ceil-divisions — keeps the anchor table aligned with the
+        # head outputs for non-power-of-two inputs (e.g. 300x300).
+        h, w = input_size
+        shapes = []
+        for i in range(3 + extra_levels):
+            s = 8 * (2**i)
+            shapes.append((-(-h // s), -(-w // s)))
+        return shapes
+
+    def anchors(self, input_size: tuple[int, int]) -> np.ndarray:
+        return generate_anchors(
+            self.feature_shapes(input_size, self.extra_levels),
+            aspect_ratios=self.aspect_ratios,
+        )

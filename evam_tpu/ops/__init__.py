@@ -1,0 +1,12 @@
+from evam_tpu.ops.preprocess import preprocess_batch, PreprocessSpec
+from evam_tpu.ops.boxes import iou_matrix, generate_anchors, decode_boxes
+from evam_tpu.ops.nms import batched_nms
+
+__all__ = [
+    "preprocess_batch",
+    "PreprocessSpec",
+    "iou_matrix",
+    "generate_anchors",
+    "decode_boxes",
+    "batched_nms",
+]

@@ -1,0 +1,101 @@
+"""Box utilities: anchors, decoding, IoU — all jit-friendly.
+
+TPU-native replacement for the PriorBox/DetectionOutput layers baked
+into the reference's 2018-era OpenVINO SSD topologies (SURVEY.md §7
+"hard parts"): anchors are generated once at trace time as constants,
+decode is a fused elementwise op, and IoU is a batched matmul-shaped
+broadcast that XLA fuses into the NMS loop.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate_anchors(
+    feature_shapes: list[tuple[int, int]],
+    image_size: tuple[int, int] = (1, 1),
+    min_scale: float = 0.1,
+    max_scale: float = 0.9,
+    aspect_ratios: tuple[float, ...] = (1.0, 2.0, 0.5),
+) -> np.ndarray:
+    """SSD-style multi-scale anchors, normalized cxcywh, shape [A, 4].
+
+    Computed in numpy (host, once per model build) — becomes an XLA
+    constant inside the jitted predict function.
+    """
+    del image_size
+    anchors = []
+    k = len(feature_shapes)
+    scales = [min_scale + (max_scale - min_scale) * i / max(k - 1, 1) for i in range(k)]
+    scales.append(1.0)
+    for idx, (fh, fw) in enumerate(feature_shapes):
+        s = scales[idx]
+        s_next = scales[idx + 1]
+        boxes_per_cell = [(s, ar) for ar in aspect_ratios]
+        boxes_per_cell.append((math.sqrt(s * s_next), 1.0))  # interpolated scale
+        for y, x in itertools.product(range(fh), range(fw)):
+            cy = (y + 0.5) / fh
+            cx = (x + 0.5) / fw
+            for scale, ar in boxes_per_cell:
+                anchors.append([cx, cy, scale * math.sqrt(ar), scale / math.sqrt(ar)])
+    return np.asarray(anchors, dtype=np.float32)
+
+
+def anchors_per_cell(aspect_ratios: tuple[float, ...] = (1.0, 2.0, 0.5)) -> int:
+    return len(aspect_ratios) + 1
+
+
+def decode_boxes(
+    deltas: jnp.ndarray,
+    anchors: jnp.ndarray,
+    variances: tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2),
+) -> jnp.ndarray:
+    """SSD center-offset decode: deltas [..., A, 4] + anchors [A, 4]
+    (cxcywh) → corner boxes [..., A, 4] (x0, y0, x1, y1), clipped to
+    the unit square (the reference emits normalized bounding_box
+    coordinates — charts/README.md:117 sample output)."""
+    acx, acy, aw, ah = jnp.split(anchors, 4, axis=-1)
+    dx, dy, dw, dh = jnp.split(deltas, 4, axis=-1)
+    cx = acx + dx * variances[0] * aw
+    cy = acy + dy * variances[1] * ah
+    w = aw * jnp.exp(jnp.clip(dw * variances[2], -10.0, 10.0))
+    h = ah * jnp.exp(jnp.clip(dh * variances[3], -10.0, 10.0))
+    boxes = jnp.concatenate(
+        [cx - w / 2.0, cy - h / 2.0, cx + w / 2.0, cy + h / 2.0], axis=-1
+    )
+    return jnp.clip(boxes, 0.0, 1.0)
+
+
+def encode_boxes(
+    boxes: jnp.ndarray,
+    anchors: jnp.ndarray,
+    variances: tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2),
+) -> jnp.ndarray:
+    """Inverse of :func:`decode_boxes` (training targets)."""
+    x0, y0, x1, y1 = jnp.split(boxes, 4, axis=-1)
+    acx, acy, aw, ah = jnp.split(anchors, 4, axis=-1)
+    cx, cy = (x0 + x1) / 2.0, (y0 + y1) / 2.0
+    w = jnp.maximum(x1 - x0, 1e-6)
+    h = jnp.maximum(y1 - y0, 1e-6)
+    dx = (cx - acx) / (aw * variances[0])
+    dy = (cy - acy) / (ah * variances[1])
+    dw = jnp.log(w / aw) / variances[2]
+    dh = jnp.log(h / ah) / variances[3]
+    return jnp.concatenate([dx, dy, dw, dh], axis=-1)
+
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU between corner boxes a [N,4] and b [M,4] → [N,M]."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)

@@ -1,0 +1,122 @@
+"""Jittable batched image preprocessing.
+
+Replaces the per-frame C++ preprocessing the reference delegates to
+DL Streamer/OpenVINO (model-proc ``input_preproc``: color_space,
+resize mode, crop — reference models_list/action-recognition-0001.json:3-13).
+Runs on-device inside the same jit as inference so resize/normalize
+fuse with the first conv: frames cross PCIe once as uint8 and all
+bandwidth-heavy work happens in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PreprocessSpec:
+    """Static preprocessing description (hashable: safe as jit static arg)."""
+
+    height: int
+    width: int
+    #: "RGB" or "BGR" — channel order the model expects. Sources decode
+    #: to BGR (cv2 convention, matching the reference's BGR pipelines,
+    #: e.g. eii pipeline caps format=BGR).
+    color_space: str = "RGB"
+    #: "stretch" | "aspect-ratio" (letterbox) | "central-crop"
+    resize: str = "stretch"
+    #: per-channel scale/shift applied as (x - mean) / std after [0,1]
+    mean: tuple[float, float, float] = (0.0, 0.0, 0.0)
+    std: tuple[float, float, float] = (1.0, 1.0, 1.0)
+    #: if True keep 0..255 range instead of 0..1 (OpenVINO-style nets)
+    raw_range: bool = True
+    dtype: str = "bfloat16"
+
+
+def preprocess_batch(frames: jax.Array, spec: PreprocessSpec) -> jax.Array:
+    """uint8 [B, H, W, 3] BGR → float [B, h, w, 3] ready for the net.
+
+    Fully shape-static: every source resizes decoded frames to a
+    bucketed input resolution on the host side only when the decode
+    resolution differs wildly; the common path sends native frames and
+    this function does the model resize on-device.
+    """
+    if frames.dtype != jnp.uint8:
+        raise ValueError(f"expected uint8 frames, got {frames.dtype}")
+    b, h, w, c = frames.shape
+    out_dtype = jnp.dtype(spec.dtype)
+
+    x = frames.astype(jnp.float32)
+    if spec.color_space.upper() == "RGB":
+        x = x[..., ::-1]  # BGR (decode convention) → RGB
+
+    th, tw = spec.height, spec.width
+    if spec.resize == "stretch" or (h, w) == (th, tw):
+        if (h, w) != (th, tw):
+            x = jax.image.resize(x, (b, th, tw, c), method="linear")
+    elif spec.resize == "aspect-ratio":
+        # Letterbox: scale to fit, pad with zeros (model-proc
+        # resize: aspect-ratio, reference models_list/action-recognition-0001.json:10).
+        scale = min(th / h, tw / w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        x = jax.image.resize(x, (b, nh, nw, c), method="linear")
+        pad_h, pad_w = th - nh, tw - nw
+        x = jnp.pad(
+            x,
+            ((0, 0), (pad_h // 2, pad_h - pad_h // 2), (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+        )
+    elif spec.resize == "central-crop":
+        # Scale shorter side to target then center-crop (model-proc
+        # crop: central, same file :11).
+        scale = max(th / h, tw / w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        x = jax.image.resize(x, (b, nh, nw, c), method="linear")
+        y0, x0 = (nh - th) // 2, (nw - tw) // 2
+        x = jax.lax.dynamic_slice(x, (0, y0, x0, 0), (b, th, tw, c))
+    else:
+        raise ValueError(f"unknown resize mode {spec.resize!r}")
+
+    if not spec.raw_range:
+        x = x / 255.0
+    mean = jnp.asarray(spec.mean, dtype=x.dtype)
+    std = jnp.asarray(spec.std, dtype=x.dtype)
+    if spec.mean != (0.0, 0.0, 0.0):
+        x = x - mean
+    if spec.std != (1.0, 1.0, 1.0):
+        x = x / std
+    return x.astype(out_dtype)
+
+
+def crop_rois(
+    frames: jax.Array,
+    boxes: jax.Array,
+    out_size: tuple[int, int],
+) -> jax.Array:
+    """Batched ROI crop+resize for secondary classification.
+
+    ``frames``: uint8/float [B, H, W, 3]; ``boxes``: [B, R, 4]
+    normalized (x0, y0, x1, y1). Returns [B, R, h, w, 3] float32.
+
+    The reference's gvaclassify crops detected regions per frame in
+    C++ (SURVEY.md §2b); here it is one gather-heavy but fully
+    batched op so classification batches stay on-device.
+    """
+    b, h, w, _ = frames.shape
+    oh, ow = out_size
+    x = frames.astype(jnp.float32)
+
+    def crop_one(img, box):
+        x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
+        # Sample an oh x ow grid inside the box via gather (nearest).
+        ys = y0 * (h - 1) + (y1 - y0) * (h - 1) * jnp.linspace(0.0, 1.0, oh)
+        xs = x0 * (w - 1) + (x1 - x0) * (w - 1) * jnp.linspace(0.0, 1.0, ow)
+        yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+        return img[yi[:, None], xi[None, :], :]
+
+    return jax.vmap(lambda img, bs: jax.vmap(lambda bb: crop_one(img, bb))(bs))(
+        x, boxes
+    )

@@ -1,0 +1,174 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.models.zoo.ssd import SSDDetector
+from evam_tpu.models.zoo.action import CLIP_LEN
+
+# Small input sizes so CPU tests stay fast; the registry supports
+# per-model overrides exactly for this (fake-TPU CI, SURVEY.md §4).
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def registry(tmp_path_factory):
+    return ModelRegistry(
+        models_dir=tmp_path_factory.mktemp("models"),
+        dtype="float32",
+        input_overrides=SMALL,
+        width_overrides=NARROW,
+    )
+
+
+def test_zoo_covers_reference_manifest():
+    # The reference manifest lists 8 OMZ models
+    # (models_list/models.list.yml); each must have a zoo counterpart.
+    omz = {s.omz_name for s in ZOO_SPECS.values()}
+    expected = {
+        "person-vehicle-bike-detection-crossroad-0078",
+        "vehicle-attributes-recognition-barrier-0039",
+        "aclnet",
+        "emotions-recognition-retail-0003",
+        "face-detection-retail-0004",
+        "action-recognition-0001-decoder",
+        "action-recognition-0001-encoder",
+        "vehicle-detection-0202",
+    }
+    assert expected <= omz
+
+
+def test_ssd_detector_forward(registry):
+    m = registry.get("object_detection/person_vehicle_bike")
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    out = jax.jit(m.forward)(m.params, x)
+    n_anchors = m.anchors.shape[0]
+    assert out["loc"].shape == (2, n_anchors, 4)
+    assert out["conf"].shape == (2, n_anchors, 4)
+
+
+def test_classifier_heads(registry):
+    m = registry.get("object_classification/vehicle_attributes")
+    x = jnp.zeros((3, 64, 64, 3), jnp.float32)
+    out = jax.jit(m.forward)(m.params, x)
+    assert out["color"].shape == (3, 7)
+    assert out["type"].shape == (3, 4)
+    assert m.head_labels["color"][0] == "white"
+
+
+def test_action_encoder_decoder(registry):
+    enc = registry.get("action_recognition/encoder")
+    dec = registry.get("action_recognition/decoder")
+    frames = jnp.zeros((CLIP_LEN, 64, 64, 3), jnp.float32)
+    emb = jax.jit(enc.forward)(enc.params, frames)
+    assert emb.shape == (CLIP_LEN, 512)
+    logits = jax.jit(dec.forward)(dec.params, emb[None])
+    assert logits.shape == (1, 400)
+
+
+def test_aclnet(registry):
+    m = registry.get("audio_detection/environment")
+    x = jnp.zeros((2, 1600), jnp.float32)
+    out = jax.jit(m.forward)(m.params, x)
+    assert out.shape == (2, 53)
+
+
+def test_deterministic_init(registry):
+    r2 = ModelRegistry(dtype="float32", input_overrides=SMALL, width_overrides=NARROW)
+    a = registry.get("object_detection/person").params
+    b = r2.get("object_detection/person").params
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_weights_roundtrip(tmp_path):
+    r = ModelRegistry(
+        models_dir=tmp_path, dtype="float32",
+        input_overrides=SMALL, width_overrides=NARROW, precision="FP32",
+    )
+    path = r.save_weights("object_detection/person")
+    assert path.exists()
+    # Mutate then reload from disk: params must come back identical.
+    r2 = ModelRegistry(
+        models_dir=tmp_path, dtype="float32",
+        input_overrides=SMALL, width_overrides=NARROW, precision="FP32",
+    )
+    m2 = r2.get("object_detection/person")
+    m1 = ModelRegistry(
+        dtype="float32", input_overrides=SMALL, width_overrides=NARROW
+    ).get("object_detection/person")
+    for la, lb in zip(jax.tree.leaves(m1.params), jax.tree.leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_model_proc_overrides_labels(tmp_path):
+    proc_dir = tmp_path / "object_detection" / "person" / "FP32"
+    proc_dir.mkdir(parents=True)
+    (proc_dir.parent / "model-proc.json").write_text(
+        '{"json_schema_version": "2.0.0", "input_preproc": '
+        '[{"format": "image", "params": {"color_space": "BGR", '
+        '"resize": "aspect-ratio"}}], '
+        '"output_postproc": [{"labels": ["bg", "human"]}]}'
+    )
+    r = ModelRegistry(models_dir=tmp_path, dtype="float32",
+                      input_overrides=SMALL, width_overrides=NARROW)
+    m = r.get("object_detection/person")
+    assert m.labels == ["bg", "human"]
+    assert m.preprocess.resize == "aspect-ratio"
+    assert m.preprocess.color_space == "BGR"
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError):
+        ModelRegistry().get("nope/nothing")
+
+
+def test_bfloat16_cast():
+    r = ModelRegistry(dtype="bfloat16", input_overrides=SMALL, width_overrides=NARROW)
+    m = r.get("object_detection/person")
+    leaf = jax.tree.leaves(m.params)[0]
+    assert leaf.dtype == jnp.bfloat16
+
+
+def test_anchor_head_alignment_nonpow2():
+    # 300x300 and (320,544) inputs: conv SAME padding rounds up, the
+    # anchor table must match the head outputs exactly.
+    for key, size in [("face_detection_retail/1", (300, 300)),
+                      ("object_detection/person", (320, 544))]:
+        r = ModelRegistry(dtype="float32", width_overrides=NARROW)
+        m = r.get(key)
+        x = jnp.zeros((1,) + size + (3,), jnp.float32)
+        out = m.module.apply({"params": m.params}, x)
+        assert out["conf"].shape[1] == m.anchors.shape[0], key
+
+
+def test_fetch_models(tmp_path):
+    from evam_tpu.models.fetch import fetch_models, parse_model_list
+    mlist = tmp_path / "models.list.yml"
+    mlist.write_text(
+        "- model: vehicle-detection-0202\n"
+        "  alias: object_detection\n"
+        "  version: vehicle\n"
+        "  precision: [FP32]\n"
+        "- model: emotions-recognition-retail-0003\n"
+        "  alias: emotion_recognition\n"
+        "  version: 1\n"
+        "  precision: [FP32]\n"
+    )
+    entries = parse_model_list(mlist)
+    assert [e["alias"] for e in entries] == ["object_detection", "emotion_recognition"]
+    # Materialization is slow at full model size; use the parse-level
+    # checks here and exercise full fetch in the CLI integration test.
+
+
+def test_parse_model_list_rejects_bad_precision(tmp_path):
+    from evam_tpu.models.fetch import ModelListError, parse_model_list
+    bad = tmp_path / "bad.yml"
+    bad.write_text("- model: aclnet\n  precision: [FP13]\n")
+    with pytest.raises(ModelListError):
+        parse_model_list(bad)

@@ -1,0 +1,124 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evam_tpu.ops import (
+    PreprocessSpec,
+    batched_nms,
+    decode_boxes,
+    generate_anchors,
+    iou_matrix,
+    preprocess_batch,
+)
+from evam_tpu.ops.boxes import encode_boxes
+from evam_tpu.ops.nms import nms_single
+from evam_tpu.ops.preprocess import crop_rois
+
+
+def test_preprocess_stretch_and_normalize():
+    frames = np.random.default_rng(0).integers(0, 255, (2, 64, 48, 3), np.uint8)
+    spec = PreprocessSpec(height=32, width=32, raw_range=False, dtype="float32")
+    out = jax.jit(preprocess_batch, static_argnums=1)(frames, spec)
+    assert out.shape == (2, 32, 32, 3)
+    assert out.dtype == jnp.float32
+    assert float(out.max()) <= 1.0
+
+
+def test_preprocess_bgr_to_rgb():
+    frame = np.zeros((1, 4, 4, 3), np.uint8)
+    frame[..., 0] = 200  # blue channel (BGR)
+    spec = PreprocessSpec(height=4, width=4, color_space="RGB", dtype="float32")
+    out = preprocess_batch(jnp.asarray(frame), spec)
+    assert float(out[0, 0, 0, 2]) == 200.0  # blue now last (RGB)
+    assert float(out[0, 0, 0, 0]) == 0.0
+
+
+def test_preprocess_letterbox_keeps_aspect():
+    # A wide white frame letterboxed into a square: rows at the top
+    # and bottom must be padding (zeros).
+    frame = np.full((1, 32, 64, 3), 255, np.uint8)
+    spec = PreprocessSpec(height=64, width=64, resize="aspect-ratio", dtype="float32")
+    out = np.asarray(preprocess_batch(jnp.asarray(frame), spec))
+    assert out.shape == (1, 64, 64, 3)
+    assert out[0, 0].max() == 0.0  # top padding
+    assert out[0, 32].max() > 200.0  # center content
+
+
+def test_iou_matrix_known_values():
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.asarray([[0.0, 0.0, 0.5, 1.0], [2.0, 2.0, 3.0, 3.0]])
+    iou = np.asarray(iou_matrix(a, b))
+    np.testing.assert_allclose(iou, [[0.5, 0.0]], atol=1e-6)
+
+
+def test_anchor_roundtrip_encode_decode():
+    anchors = generate_anchors([(4, 4), (2, 2), (1, 1)])
+    assert anchors.shape[1] == 4
+    rng = np.random.default_rng(1)
+    n = anchors.shape[0]
+    boxes = np.zeros((n, 4), np.float32)
+    boxes[:, :2] = rng.uniform(0.1, 0.4, (n, 2))
+    boxes[:, 2:] = boxes[:, :2] + rng.uniform(0.1, 0.4, (n, 2))
+    deltas = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors))
+    back = decode_boxes(deltas, jnp.asarray(anchors))
+    np.testing.assert_allclose(np.asarray(back), boxes, atol=1e-4)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray(
+        [
+            [0.1, 0.1, 0.5, 0.5],
+            [0.12, 0.12, 0.52, 0.52],  # overlaps first, lower score
+            [0.6, 0.6, 0.9, 0.9],
+        ]
+    )
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    labels = jnp.asarray([1, 1, 2], jnp.int32)
+    out_boxes, out_scores, out_labels, valid = nms_single(boxes, scores, labels, 4)
+    assert int(valid.sum()) == 2
+    np.testing.assert_allclose(np.asarray(out_scores[:2]), [0.9, 0.7], atol=1e-6)
+    assert out_labels[1] == 2
+
+
+def test_nms_sequential_semantics():
+    # a suppresses b; b overlaps c but is itself suppressed, so c stays.
+    boxes = jnp.asarray(
+        [
+            [0.0, 0.0, 0.4, 0.4],
+            [0.1, 0.1, 0.5, 0.5],
+            [0.2, 0.2, 0.6, 0.6],
+        ]
+    )
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    labels = jnp.ones(3, jnp.int32)
+    *_, out_labels, valid = nms_single(
+        boxes, scores, labels, 4, iou_threshold=0.3
+    )
+    assert int(valid.sum()) == 2  # a and c survive
+
+
+def test_batched_nms_shapes_and_background():
+    b, a, c = 3, 50, 4
+    rng = np.random.default_rng(2)
+    boxes = np.zeros((b, a, 4), np.float32)
+    boxes[..., :2] = rng.uniform(0, 0.5, (b, a, 2))
+    boxes[..., 2:] = boxes[..., :2] + rng.uniform(0.05, 0.5, (b, a, 2))
+    scores = rng.uniform(0, 1, (b, a, c)).astype(np.float32)
+    out_boxes, out_scores, out_labels, valid = jax.jit(batched_nms)(
+        jnp.asarray(boxes), jnp.asarray(scores)
+    )
+    assert out_boxes.shape == (b, 32, 4)
+    assert out_labels.shape == (b, 32)
+    # background (class 0) never emitted
+    assert int(jnp.min(jnp.where(valid, out_labels, 1))) >= 1
+
+
+def test_crop_rois():
+    frames = np.zeros((1, 100, 100, 3), np.uint8)
+    frames[:, 40:60, 40:60] = 255
+    boxes = jnp.asarray([[[0.4, 0.4, 0.6, 0.6], [0.0, 0.0, 0.2, 0.2]]])
+    crops = crop_rois(jnp.asarray(frames), boxes, (8, 8))
+    assert crops.shape == (1, 2, 8, 8, 3)
+    assert float(crops[0, 0].min()) > 200.0  # white region
+    assert float(crops[0, 1].max()) == 0.0  # black region
