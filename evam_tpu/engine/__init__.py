@@ -1,0 +1,22 @@
+from evam_tpu.engine.batcher import BatchEngine, EngineStats
+from evam_tpu.engine.hub import EngineHub
+from evam_tpu.engine.steps import (
+    build_detect_step,
+    build_classify_step,
+    build_action_encode_step,
+    build_action_decode_step,
+    build_audio_step,
+    DETECT_FIELDS,
+)
+
+__all__ = [
+    "BatchEngine",
+    "EngineStats",
+    "EngineHub",
+    "build_detect_step",
+    "build_classify_step",
+    "build_action_encode_step",
+    "build_action_decode_step",
+    "build_audio_step",
+    "DETECT_FIELDS",
+]

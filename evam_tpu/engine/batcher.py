@@ -1,0 +1,263 @@
+"""The shared batch engine — the heart of the framework.
+
+Where the reference runs one inference engine per GStreamer pipeline
+(optionally shared via ``model-instance-id``,
+reference pipelines/object_detection/person_vehicle_bike/
+pipeline.json:26-32), evam_tpu runs ONE BatchEngine per model
+instance and multiplexes every active stream into it (BASELINE.json
+north_star). Three cooperating threads per engine:
+
+  submit() ──queue──► dispatcher ──in-flight──► completion ──► futures
+
+* the **dispatcher** collects items up to a batch deadline
+  (latency/occupancy tension, SURVEY.md §7 "hard parts"), pads to a
+  bucketed batch size (bounded compile count), places the batch on
+  the mesh (data-axis sharded) and launches the jitted step —
+  WITHOUT waiting for the result;
+* the **completion** thread performs the single device→host readback
+  per batch and resolves per-item futures. Keeping dispatch and
+  readback on separate threads double-buffers the device: batch N+1
+  is enqueued while batch N computes (the decode-ahead/infer overlap
+  the reference gets from GStreamer element threads, SURVEY.md §2d-5);
+* an in-flight semaphore bounds device queueing (backpressure, the
+  analogue of the reference msgbus ``zmq_recv_hwm``,
+  eii/config.json:37).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable
+
+import jax
+import numpy as np
+
+from evam_tpu.obs import get_logger, metrics
+from evam_tpu.parallel.mesh import MeshPlan
+
+log = get_logger("engine.batcher")
+
+
+@dataclasses.dataclass
+class _WorkItem:
+    inputs: dict[str, np.ndarray]
+    future: Future
+    t_submit: float
+
+
+@dataclasses.dataclass
+class EngineStats:
+    batches: int = 0
+    items: int = 0
+    occupancy_sum: float = 0.0
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.occupancy_sum / self.batches if self.batches else 0.0
+
+
+class BatchEngine:
+    """Deadline-batching dispatcher around one jitted step function.
+
+    ``step_fn(params, **batch) -> packed`` must accept stacked inputs
+    (leading batch axis) and return one array whose leading axis
+    matches. Bucketed batch sizes keep the number of distinct
+    compiled programs small (recompilation-storm guard)."""
+
+    def __init__(
+        self,
+        name: str,
+        step_fn: Callable,
+        params,
+        plan: MeshPlan | None = None,
+        max_batch: int = 32,
+        deadline_ms: float = 8.0,
+        max_in_flight: int = 3,
+        input_names: tuple[str, ...] = ("frames",),
+    ):
+        self.name = name
+        self.plan = plan
+        self.max_batch = max_batch
+        self.deadline_s = deadline_ms / 1000.0
+        self.input_names = input_names
+        self.stats = EngineStats()
+
+        d = plan.data_size if plan else 1
+        top = plan.pad_batch(max_batch) if plan else max_batch
+        self.buckets = []
+        b = d
+        while b < top:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(top)
+
+        if plan is not None:
+            self._params = jax.device_put(params, plan.replicated())
+            self._jit_step = jax.jit(
+                step_fn,
+                in_shardings=(
+                    plan.replicated(),
+                    *([plan.batch_sharding()] * len(input_names)),
+                ),
+            )
+        else:
+            self._params = params
+            self._jit_step = jax.jit(step_fn)
+
+        self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
+        self._done: queue.Queue[tuple | None] = queue.Queue()
+        self._in_flight = threading.Semaphore(max_in_flight)
+        self._stop = threading.Event()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name=f"engine-{name}-dispatch", daemon=True
+        )
+        self._completer = threading.Thread(
+            target=self._completion_loop, name=f"engine-{name}-complete", daemon=True
+        )
+        self._dispatcher.start()
+        self._completer.start()
+
+    # ------------------------------------------------------------- API
+
+    def submit(self, **inputs: np.ndarray) -> Future:
+        """Enqueue one item (no batch dim); resolves to its packed row(s)."""
+        if self._stop.is_set():
+            raise RuntimeError(f"engine {self.name} is stopped")
+        if set(inputs) != set(self.input_names):
+            raise ValueError(
+                f"engine {self.name} expects inputs {self.input_names}, got {tuple(inputs)}"
+            )
+        fut: Future = Future()
+        self._queue.put(_WorkItem(inputs, fut, time.perf_counter()))
+        return fut
+
+    def warmup(self) -> None:
+        """Compile every bucket size ahead of traffic."""
+        example = self._example_item()
+        for b in self.buckets:
+            batch = {
+                k: np.broadcast_to(v, (b,) + v.shape).copy()
+                for k, v in example.items()
+            }
+            np.asarray(self._run(batch))
+        log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.put(None)
+        self._dispatcher.join(timeout=10)
+        self._done.put(None)
+        self._completer.join(timeout=10)
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item.future.set_exception(RuntimeError("engine stopped"))
+
+    # -------------------------------------------------------- internals
+
+    def _example_item(self) -> dict[str, np.ndarray]:
+        item = self._peek_shapes
+        if item is None:
+            raise RuntimeError("warmup requires example_shapes")
+        return item
+
+    #: optional dict name -> example array (no batch dim) for warmup
+    _peek_shapes: dict[str, np.ndarray] | None = None
+
+    def set_example(self, **example: np.ndarray) -> None:
+        self._peek_shapes = example
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _run(self, batch: dict[str, np.ndarray]):
+        arrays = []
+        for name in self.input_names:
+            a = batch[name]
+            if self.plan is not None:
+                a = jax.device_put(a, self.plan.batch_sharding())
+            arrays.append(a)
+        return self._jit_step(self._params, *arrays)
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                break
+            items = [first]
+            deadline = time.perf_counter() + self.deadline_s
+            while len(items) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop.set()
+                    break
+                items.append(nxt)
+
+            n = len(items)
+            b = self._bucket(n)
+            batch: dict[str, np.ndarray] = {}
+            for name in self.input_names:
+                rows = [it.inputs[name] for it in items]
+                stacked = np.stack(rows)
+                if b > n:
+                    pad = np.zeros((b - n,) + stacked.shape[1:], stacked.dtype)
+                    stacked = np.concatenate([stacked, pad])
+                batch[name] = stacked
+
+            self._in_flight.acquire()
+            t0 = time.perf_counter()
+            try:
+                out = self._run(batch)
+            except Exception as exc:  # noqa: BLE001 — surface to every caller
+                self._in_flight.release()
+                for it in items:
+                    it.future.set_exception(exc)
+                log.exception("engine %s step failed", self.name)
+                continue
+            self._done.put((out, items, t0))
+            self.stats.batches += 1
+            self.stats.items += n
+            self.stats.occupancy_sum += n / b
+            metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
+            metrics.set("evam_engine_queue_depth", self._queue.qsize(), {"engine": self.name})
+
+    def _completion_loop(self) -> None:
+        while True:
+            entry = self._done.get()
+            if entry is None:
+                break
+            out, items, t0 = entry
+            try:
+                host = np.asarray(out)  # single readback per batch
+            except Exception as exc:  # noqa: BLE001
+                for it in items:
+                    it.future.set_exception(exc)
+                self._in_flight.release()
+                continue
+            self._in_flight.release()
+            now = time.perf_counter()
+            metrics.observe("evam_step_seconds", now - t0, {"engine": self.name})
+            for i, it in enumerate(items):
+                metrics.observe(
+                    "evam_item_latency_seconds", now - it.t_submit, {"engine": self.name}
+                )
+                it.future.set_result(host[i])

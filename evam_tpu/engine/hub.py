@@ -1,0 +1,105 @@
+"""EngineHub: model-instance-id → shared BatchEngine.
+
+Implements the reference's engine-sharing contract: pipelines that
+pass the same ``model-instance-id`` share one inference engine and
+its batch queue (reference pipelines/object_detection/
+person_vehicle_bike/pipeline.json:26-32, SURVEY.md §2d-2). Pipelines
+that omit it share per-model-key engines — the cross-stream batching
+default that the TPU design is built around.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from evam_tpu.engine import steps as step_builders
+from evam_tpu.engine.batcher import BatchEngine
+from evam_tpu.models.registry import LoadedModel, ModelRegistry
+from evam_tpu.obs import get_logger
+from evam_tpu.parallel.mesh import MeshPlan
+
+log = get_logger("engine.hub")
+
+_BUILDERS = {
+    "detect": (step_builders.build_detect_step, ("frames",)),
+    "classify": (step_builders.build_classify_step, ("frames", "boxes")),
+    "action_encode": (step_builders.build_action_encode_step, ("frames",)),
+    "action_decode": (step_builders.build_action_decode_step, ("clips",)),
+    "audio": (step_builders.build_audio_step, ("windows",)),
+}
+
+
+class EngineHub:
+    """Creates/caches engines; one per (kind, model key or instance id)."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        plan: MeshPlan | None = None,
+        max_batch: int = 32,
+        deadline_ms: float = 8.0,
+    ):
+        self.registry = registry
+        self.plan = plan
+        self.max_batch = max_batch
+        self.deadline_ms = deadline_ms
+        self._engines: dict[str, BatchEngine] = {}
+        self._models: dict[str, LoadedModel] = {}
+        # RLock: engine() calls model() while holding the lock.
+        self._lock = threading.RLock()
+
+    def model(self, model_key: str) -> LoadedModel:
+        with self._lock:
+            if model_key not in self._models:
+                self._models[model_key] = self.registry.get(model_key)
+            return self._models[model_key]
+
+    def engine(
+        self,
+        kind: str,
+        model_key: str,
+        instance_id: str | None = None,
+        **builder_kwargs,
+    ) -> BatchEngine:
+        """Get or create the shared engine for (kind, model, instance).
+
+        ``instance_id`` is the model-instance-id parameter; None
+        defaults to sharing by model key (maximum batching).
+        """
+        if kind not in _BUILDERS:
+            raise ValueError(f"no step builder for stage kind '{kind}'")
+        key = f"{kind}:{instance_id or model_key}"
+        with self._lock:
+            if key not in self._engines:
+                model = self.model(model_key)
+                builder, input_names = _BUILDERS[kind]
+                step_fn = builder(model, **builder_kwargs)
+                self._engines[key] = BatchEngine(
+                    name=key,
+                    step_fn=step_fn,
+                    params=model.params,
+                    plan=self.plan,
+                    max_batch=self.max_batch,
+                    deadline_ms=self.deadline_ms,
+                    input_names=input_names,
+                )
+                log.info("created engine %s (model %s)", key, model_key)
+            return self._engines[key]
+
+    def stats(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                k: {
+                    "batches": e.stats.batches,
+                    "items": e.stats.items,
+                    "mean_occupancy": e.stats.mean_occupancy,
+                }
+                for k, e in self._engines.items()
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            engines = list(self._engines.values())
+            self._engines.clear()
+        for e in engines:
+            e.stop()

@@ -1,0 +1,138 @@
+"""Jitted step builders: one fused XLA program per model stage.
+
+Each builder returns ``(step_fn, params)`` where ``step_fn(params,
+**batch)`` maps a uint8 host batch to ONE packed float32 array.
+Replaces the reference's per-frame OpenVINO infer requests inside
+gvadetect/gvaclassify/gvaactionrecognitionbin/gvaaudiodetect
+(SURVEY.md §2b) with cross-stream batched programs.
+
+Design constraints (measured on the tunneled v5e, see engine tests):
+* single packed output array — each extra device→host readback costs
+  a full RTT (~70 ms through the tunnel), so steps never return
+  tuples;
+* everything fused — preprocess, net, decode, NMS in one jit, frames
+  cross the host boundary exactly once as uint8;
+* static shapes — batch size is bucketed by the caller, ROI budget
+  and NMS K are fixed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from evam_tpu.models.registry import LoadedModel
+from evam_tpu.ops.boxes import decode_boxes
+from evam_tpu.ops.nms import batched_nms
+from evam_tpu.ops.preprocess import crop_rois, preprocess_batch
+
+#: Packed detection row layout: [x0, y0, x1, y1, score, label, valid]
+DETECT_FIELDS = 7
+
+
+def build_detect_step(
+    model: LoadedModel,
+    max_detections: int = 32,
+    iou_threshold: float = 0.45,
+    score_threshold: float = 0.3,
+) -> Callable:
+    """uint8 frames [B,H,W,3] → packed detections [B,K,7] float32."""
+    anchors = jnp.asarray(model.anchors)
+    preproc = model.preprocess
+    forward = model.forward
+
+    def step(params, frames):
+        x = preprocess_batch(frames, preproc)
+        out = forward(params, x)
+        boxes = decode_boxes(out["loc"].astype(jnp.float32), anchors)
+        scores = jax.nn.softmax(out["conf"].astype(jnp.float32), axis=-1)
+        bx, sc, lb, valid = batched_nms(
+            boxes,
+            scores,
+            max_outputs=max_detections,
+            iou_threshold=iou_threshold,
+            score_threshold=score_threshold,
+        )
+        return jnp.concatenate(
+            [
+                bx,
+                sc[..., None],
+                lb[..., None].astype(jnp.float32),
+                valid[..., None].astype(jnp.float32),
+            ],
+            axis=-1,
+        )
+
+    return step
+
+
+def build_classify_step(model: LoadedModel, roi_budget: int = 8) -> Callable:
+    """Frames + ROI boxes → packed per-ROI head probabilities.
+
+    ``frames`` uint8 [B,H,W,3]; ``boxes`` float32 [B,R,4] normalized
+    corners (R = roi_budget, invalid rows zeroed). Output
+    [B, R, total_classes] — concatenated per-head probability vectors
+    (head order = model.spec.heads). ROI crop happens on-device so
+    detection output never has to round-trip through the host between
+    the detect and classify engines beyond the box coordinates.
+    """
+    preproc = model.preprocess
+    forward = model.forward
+    head_sizes = [n for _, n in model.spec.heads]
+
+    def step(params, frames, boxes):
+        b, r = boxes.shape[:2]
+        crops = crop_rois(frames, boxes, (preproc.height, preproc.width))
+        crops = crops.reshape((b * r,) + crops.shape[2:]).astype(jnp.uint8)
+        x = preprocess_batch(crops, preproc)
+        out = forward(params, x)  # dict head -> [B*R, n]
+        probs = [
+            jax.nn.softmax(out[name].astype(jnp.float32), axis=-1)
+            for name, _ in model.spec.heads
+        ]
+        packed = jnp.concatenate(probs, axis=-1)
+        return packed.reshape(b, r, sum(head_sizes))
+
+    return step
+
+
+def build_action_encode_step(model: LoadedModel) -> Callable:
+    """uint8 frames [B,H,W,3] → embeddings [B,D] float32."""
+    preproc = model.preprocess
+    forward = model.forward
+
+    def step(params, frames):
+        x = preprocess_batch(frames, preproc)
+        return forward(params, x).astype(jnp.float32)
+
+    return step
+
+
+def build_action_decode_step(model: LoadedModel) -> Callable:
+    """Embedding clips [B,T,D] float32 → class probabilities [B,C]."""
+    forward = model.forward
+
+    def step(params, clips):
+        logits = forward(params, clips)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    return step
+
+
+def build_audio_step(model: LoadedModel) -> Callable:
+    """int16 audio windows [B,S] → class probabilities [B,C].
+
+    Normalization of S16LE to [-1, 1] happens on-device (the
+    reference's gvaaudiodetect consumes S16LE directly,
+    pipelines/audio_detection/environment/pipeline.json:5).
+    """
+    forward = model.forward
+
+    def step(params, windows):
+        x = windows.astype(jnp.float32) / 32768.0
+        logits = forward(params, x)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    return step

@@ -1,0 +1,19 @@
+from evam_tpu.media.source import (
+    AppSource,
+    FileSource,
+    FrameEvent,
+    SyntheticSource,
+    VideoSource,
+    create_source,
+)
+from evam_tpu.media.decode import DecodeWorker
+
+__all__ = [
+    "AppSource",
+    "FileSource",
+    "FrameEvent",
+    "SyntheticSource",
+    "VideoSource",
+    "create_source",
+    "DecodeWorker",
+]

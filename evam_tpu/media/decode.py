@@ -1,0 +1,129 @@
+"""Decode worker: one lightweight thread per stream.
+
+The reference gives every stream a full GStreamer thread graph; here
+a stream costs one decode thread that feeds the shared TPU engines.
+Includes the per-stream supervision the reference lacks (SURVEY.md
+§5.3): source errors trigger reconnect-with-backoff instead of
+killing the engine, and a dead stream never takes the batch engine
+down.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable
+
+from evam_tpu.media.source import FrameEvent, VideoSource
+from evam_tpu.obs import get_logger, metrics
+
+log = get_logger("media.decode")
+
+
+class DecodeWorker:
+    """Reads a source on a daemon thread into a bounded queue.
+
+    ``on_frame`` (if given) is called inline on the decode thread and
+    its return ignored; otherwise frames land in ``self.queue``.
+    Bounded queue = backpressure: when the engine falls behind, frames
+    drop oldest-first (live-stream semantics) rather than growing
+    memory — the behavior knob is ``drop_when_full``.
+    """
+
+    def __init__(
+        self,
+        stream_id: str,
+        source_factory: Callable[[], VideoSource],
+        maxsize: int = 8,
+        drop_when_full: bool = True,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.5,
+        on_frame: Callable[[FrameEvent], None] | None = None,
+    ):
+        self.stream_id = stream_id
+        self.source_factory = source_factory
+        self.queue: queue.Queue[FrameEvent | None] = queue.Queue(maxsize=maxsize)
+        self.drop_when_full = drop_when_full
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.on_frame = on_frame
+        self.frames_decoded = 0
+        self.frames_dropped = 0
+        self.error: str | None = None
+        self._source: VideoSource | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"decode-{stream_id}", daemon=True
+        )
+
+    def start(self) -> "DecodeWorker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._source is not None:
+            self._source.close()
+        self._thread.join(timeout=10)
+
+    @property
+    def finished(self) -> bool:
+        return not self._thread.is_alive()
+
+    def _emit(self, ev: FrameEvent) -> None:
+        self.frames_decoded += 1
+        metrics.inc("evam_frames_decoded", labels={"stream": self.stream_id})
+        if self.on_frame is not None:
+            self.on_frame(ev)
+            return
+        if self.drop_when_full:
+            while True:
+                try:
+                    self.queue.put_nowait(ev)
+                    return
+                except queue.Full:
+                    try:
+                        self.queue.get_nowait()
+                        self.frames_dropped += 1
+                        metrics.inc(
+                            "evam_frames_dropped", labels={"stream": self.stream_id}
+                        )
+                    except queue.Empty:
+                        pass
+        else:
+            while not self._stop.is_set():
+                try:
+                    self.queue.put(ev, timeout=0.5)
+                    return
+                except queue.Full:
+                    continue
+
+    def _run(self) -> None:
+        restarts = 0
+        while not self._stop.is_set():
+            try:
+                self._source = self.source_factory()
+                for ev in self._source.frames():
+                    if self._stop.is_set():
+                        break
+                    self._emit(ev)
+                break  # clean EOS
+            except Exception as exc:  # noqa: BLE001 — supervised restart
+                restarts += 1
+                self.error = str(exc)
+                metrics.inc("evam_stream_errors", labels={"stream": self.stream_id})
+                if restarts > self.max_restarts or self._stop.is_set():
+                    log.error(
+                        "stream %s failed permanently after %d restarts: %s",
+                        self.stream_id, restarts - 1, exc,
+                    )
+                    break
+                backoff = self.restart_backoff_s * (2 ** (restarts - 1))
+                log.warning(
+                    "stream %s source error (%s); restart %d/%d in %.1fs",
+                    self.stream_id, exc, restarts, self.max_restarts, backoff,
+                )
+                time.sleep(backoff)
+        if self.on_frame is None:
+            self.queue.put(None)  # EOS sentinel

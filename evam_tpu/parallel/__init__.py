@@ -1,0 +1,15 @@
+from evam_tpu.parallel.mesh import (
+    MeshPlan,
+    build_mesh,
+    batch_sharding,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshPlan",
+    "build_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+]

@@ -1,0 +1,11 @@
+from evam_tpu.stages.context import FrameContext, Region, Tensor
+from evam_tpu.stages.build import build_stages
+from evam_tpu.stages.runner import StreamRunner
+
+__all__ = [
+    "FrameContext",
+    "Region",
+    "Tensor",
+    "build_stages",
+    "StreamRunner",
+]

@@ -1,0 +1,71 @@
+"""Stage factory: resolved StageSpec chain → executable Stage objects.
+
+The graph layer (evam_tpu.graph) parses definitions and binds
+parameters; this module instantiates the runtime stages, wiring
+engine-backed stages to the shared EngineHub. Source/decode/sink
+specs are handled by the StreamInstance (they define IO, not
+per-frame transforms)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from evam_tpu.engine.hub import EngineHub
+from evam_tpu.graph.spec import StageKind, StageSpec
+from evam_tpu.stages.base import Stage
+from evam_tpu.stages.context import FrameContext
+from evam_tpu.stages.infer import (
+    ActionStage,
+    AudioDetectStage,
+    ClassifyStage,
+    DetectStage,
+)
+from evam_tpu.stages.meta import MetaconvertStage, PublishStage, SinkStage
+from evam_tpu.stages.misc import AudioMixStage, ConvertStage, LevelStage
+from evam_tpu.stages.track import TrackStage
+from evam_tpu.stages.udf import UdfStage
+
+
+def build_stages(
+    specs: list[StageSpec],
+    hub: EngineHub,
+    source_uri: str = "",
+    publish_fn: Callable[[FrameContext], None] | None = None,
+    sink_fn: Callable[[FrameContext], None] | None = None,
+) -> list[Stage]:
+    stages: list[Stage] = []
+    for spec in specs:
+        kind = spec.kind
+        if kind in (StageKind.SOURCE, StageKind.DECODE):
+            continue  # handled by the StreamInstance's DecodeWorker
+        if kind == StageKind.DETECT:
+            stages.append(DetectStage(spec.name, spec.model, spec.properties, hub))
+        elif kind == StageKind.CLASSIFY:
+            stages.append(ClassifyStage(spec.name, spec.model, spec.properties, hub))
+        elif kind == StageKind.TRACK:
+            stages.append(TrackStage(spec.name, spec.properties))
+        elif kind == StageKind.ACTION:
+            stages.append(ActionStage(spec.name, spec.properties, hub))
+        elif kind == StageKind.AUDIO_DETECT:
+            stages.append(
+                AudioDetectStage(spec.name, spec.model, spec.properties, hub)
+            )
+        elif kind == StageKind.UDF:
+            stages.append(UdfStage(spec.name, spec.properties))
+        elif kind == StageKind.METACONVERT:
+            stages.append(
+                MetaconvertStage(spec.name, spec.properties, source_uri=source_uri)
+            )
+        elif kind == StageKind.PUBLISH:
+            stages.append(PublishStage(spec.name, publish_fn))
+        elif kind == StageKind.SINK:
+            stages.append(SinkStage(spec.name, sink_fn))
+        elif kind == StageKind.CONVERT:
+            stages.append(ConvertStage(spec.name, spec.properties))
+        elif kind == StageKind.AUDIO_MIX:
+            stages.append(AudioMixStage(spec.name, spec.properties))
+        elif kind == StageKind.LEVEL:
+            stages.append(LevelStage(spec.name, spec.properties))
+        else:
+            raise ValueError(f"no runtime stage for kind {kind}")
+    return stages

@@ -1,0 +1,91 @@
+"""Per-frame context flowing through a stream's stage chain.
+
+The TPU-native restatement of DL Streamer's VideoFrame/ROI model: the
+reference exposes regions with rect / object_id / tensors (name,
+confidence, label_id, label) — consumed at
+reference evas/publisher.py:193-230 — and JSON messages attached by
+UDF extensions. FrameContext carries the same information as plain
+Python data, with numpy arrays for geometry so stage math stays
+vectorized.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+
+@dataclass
+class Tensor:
+    """One inference result attached to a region (detection or
+    classification attribute), mirroring the reference's region
+    tensor fields (evas/publisher.py:216-228)."""
+
+    name: str
+    confidence: float
+    label_id: int
+    label: str = ""
+    is_detection: bool = False
+    data: list[float] | None = None
+
+
+@dataclass
+class Region:
+    """A detected object. Geometry normalized [0,1] corners plus the
+    pixel rect the reference publishes (charts/README.md:117 has both
+    normalized bounding_box and pixel x/y/w/h)."""
+
+    x0: float
+    y0: float
+    x1: float
+    y1: float
+    confidence: float
+    label_id: int
+    label: str
+    object_id: int | None = None
+    tensors: list[Tensor] = field(default_factory=list)
+
+    def rect(self, width: int, height: int) -> tuple[int, int, int, int]:
+        x = int(round(self.x0 * width))
+        y = int(round(self.y0 * height))
+        w = int(round((self.x1 - self.x0) * width))
+        h = int(round((self.y1 - self.y0) * height))
+        return x, y, w, h
+
+    @property
+    def box(self) -> np.ndarray:
+        return np.asarray([self.x0, self.y0, self.x1, self.y1], np.float32)
+
+
+@dataclass
+class FrameContext:
+    """State of one frame (or audio window) walking the stage chain."""
+
+    frame: np.ndarray | None  # BGR uint8 [H,W,3]; None for audio
+    pts_ns: int
+    seq: int
+    stream_id: str
+    source_uri: str = ""
+    regions: list[Region] = field(default_factory=list)
+    #: frame-level tensors (action recognition, audio events)
+    tensors: list[Tensor] = field(default_factory=list)
+    #: JSON messages attached by UDF stages (events etc.)
+    messages: list[dict[str, Any]] = field(default_factory=list)
+    #: serialized metadata (set by metaconvert)
+    metadata: dict[str, Any] | None = None
+    #: audio samples for audio pipelines (int16 [S])
+    audio: np.ndarray | None = None
+    #: stage cursor used by the runner
+    stage_index: int = 0
+    #: arbitrary cross-stage scratch (e.g. pending futures)
+    scratch: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def height(self) -> int:
+        return 0 if self.frame is None else int(self.frame.shape[0])
+
+    @property
+    def width(self) -> int:
+        return 0 if self.frame is None else int(self.frame.shape[1])

@@ -1,0 +1,281 @@
+"""Engine-backed inference stages: detect, classify, action, audio.
+
+These are the TPU counterparts of the reference's gvadetect /
+gvaclassify / gvaactionrecognitionbin / gvaaudiodetect elements
+(SURVEY.md §2b), sharing per-model BatchEngines across streams
+(model-instance-id semantics) instead of owning per-stream OpenVINO
+requests.
+
+Thresholds are applied host-side on the packed engine output so
+engines stay shareable between pipelines with different ``threshold``
+parameters (the engine's in-jit NMS uses a permissive floor).
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from evam_tpu.engine.hub import EngineHub
+from evam_tpu.models.zoo.action import CLIP_LEN
+from evam_tpu.obs import get_logger
+from evam_tpu.stages.base import AsyncStage
+from evam_tpu.stages.context import FrameContext, Region, Tensor
+
+log = get_logger("stages.infer")
+
+#: floor baked into the shared engine's NMS; per-stage thresholds
+#: filter above this.
+ENGINE_SCORE_FLOOR = 0.1
+
+
+def _resize_for_engine(frame: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """Host-side resize to the engine's canonical ingest resolution so
+    frames from heterogeneous streams stack into one batch."""
+    h, w = size
+    if frame.shape[0] == h and frame.shape[1] == w:
+        return frame
+    import cv2
+
+    return cv2.resize(frame, (w, h), interpolation=cv2.INTER_LINEAR)
+
+
+class DetectStage(AsyncStage):
+    """gvadetect counterpart. Properties (reference
+    pipelines/object_detection/person_vehicle_bike/pipeline.json:18-40):
+    device, threshold, inference-interval, model-instance-id."""
+
+    def __init__(self, name: str, model_key: str, properties: dict, hub: EngineHub):
+        self.name = name
+        self.model_key = model_key
+        self.threshold = float(properties.get("threshold", 0.5))
+        if self.threshold < ENGINE_SCORE_FLOOR:
+            log.warning(
+                "detect stage %s threshold %.3f below shared-engine floor %.2f; "
+                "effective threshold is %.2f",
+                name, self.threshold, ENGINE_SCORE_FLOOR, ENGINE_SCORE_FLOOR,
+            )
+        self.interval = max(1, int(properties.get("inference-interval", 1)))
+        self.engine = hub.engine(
+            "detect",
+            model_key,
+            properties.get("model-instance-id"),
+            score_threshold=ENGINE_SCORE_FLOOR,
+        )
+        self.model = hub.model(model_key)
+        self.ingest_size = (self.model.preprocess.height, self.model.preprocess.width)
+        self._count = 0
+        self._last_regions: list[Region] = []
+
+    def submit(self, ctx: FrameContext) -> Future | None:
+        self._count += 1
+        if (self._count - 1) % self.interval:
+            return None  # inference-interval skip: reuse last regions
+        frame = _resize_for_engine(ctx.frame, self.ingest_size)
+        return self.engine.submit(frames=np.ascontiguousarray(frame))
+
+    def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
+        if result is None:
+            # inference-interval skip: reuse last detections, deep-copied
+            # so downstream stages never mutate shared cross-frame state.
+            ctx.regions.extend(copy.deepcopy(self._last_regions))
+            return [ctx]
+        labels = self.model.labels
+        regions = []
+        for row in result:
+            x0, y0, x1, y1, score, label_id, valid = row
+            if valid < 0.5 or score < self.threshold:
+                continue
+            lid = int(label_id)
+            label = labels[lid] if 0 <= lid < len(labels) else str(lid)
+            region = Region(
+                x0=float(x0), y0=float(y0), x1=float(x1), y1=float(y1),
+                confidence=float(score), label_id=lid, label=label,
+            )
+            region.tensors.append(
+                Tensor(
+                    name="detection",
+                    confidence=float(score),
+                    label_id=lid,
+                    label=label,
+                    is_detection=True,
+                )
+            )
+            regions.append(region)
+        self._last_regions = regions
+        ctx.regions.extend(regions)
+        return [ctx]
+
+
+class ClassifyStage(AsyncStage):
+    """gvaclassify counterpart. Properties (reference
+    pipelines/object_classification/vehicle_attributes/pipeline.json:63-85):
+    object-class, reclassify-interval, threshold, model-instance-id."""
+
+    ROI_BUDGET = 8
+
+    def __init__(self, name: str, model_key: str, properties: dict, hub: EngineHub):
+        self.name = name
+        self.model_key = model_key
+        self.object_class = properties.get("object-class")
+        self.interval = max(1, int(properties.get("reclassify-interval", 1)))
+        self.threshold = float(properties.get("threshold", 0.0))
+        self.engine = hub.engine(
+            "classify",
+            model_key,
+            properties.get("model-instance-id"),
+            roi_budget=self.ROI_BUDGET,
+        )
+        self.model = hub.model(model_key)
+        # Crops are taken on-device from the submitted frame; a fixed
+        # canonical ingest resolution keeps cross-stream batches
+        # stackable while preserving enough pixels for small ROIs.
+        self.ingest_size = tuple(properties.get("ingest-size", (432, 768)))
+        self._count = 0
+
+    def _eligible(self, ctx: FrameContext) -> list[Region]:
+        return [
+            r
+            for r in ctx.regions
+            if self.object_class in (None, "", r.label)
+        ][: self.ROI_BUDGET]
+
+    def submit(self, ctx: FrameContext) -> Future | None:
+        self._count += 1
+        if (self._count - 1) % self.interval:
+            return None
+        regions = self._eligible(ctx)
+        if not regions:
+            return None
+        boxes = np.zeros((self.ROI_BUDGET, 4), np.float32)
+        for i, r in enumerate(regions):
+            boxes[i] = [r.x0, r.y0, r.x1, r.y1]
+        frame = _resize_for_engine(ctx.frame, self.ingest_size)
+        return self.engine.submit(frames=np.ascontiguousarray(frame), boxes=boxes)
+
+    def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
+        if result is None:
+            return [ctx]
+        regions = self._eligible(ctx)
+        offset = 0
+        head_slices = []
+        for head_name, n in self.model.spec.heads:
+            head_slices.append((head_name, offset, offset + n))
+            offset += n
+        for i, region in enumerate(regions):
+            for head_name, a, b in head_slices:
+                probs = result[i, a:b]
+                lid = int(np.argmax(probs))
+                conf = float(probs[lid])
+                if conf < self.threshold:
+                    continue
+                label_list = self.model.head_labels.get(head_name, [])
+                region.tensors.append(
+                    Tensor(
+                        name=head_name,
+                        confidence=conf,
+                        label_id=lid,
+                        label=label_list[lid] if lid < len(label_list) else str(lid),
+                    )
+                )
+        return [ctx]
+
+
+class ActionStage(AsyncStage):
+    """gvaactionrecognitionbin counterpart: per-frame encoder + 16-frame
+    sliding-clip decoder (reference pipelines/action_recognition/general/
+    pipeline.json:4, composite model note in that README:13-19)."""
+
+    def __init__(self, name: str, properties: dict, hub: EngineHub):
+        self.name = name
+        enc_key = properties.get("enc-model", "action_recognition/encoder")
+        dec_key = properties.get("dec-model", "action_recognition/decoder")
+        self.enc_engine = hub.engine("action_encode", enc_key,
+                                     properties.get("model-instance-id"))
+        self.dec_engine = hub.engine("action_decode", dec_key)
+        self.dec_model = hub.model(dec_key)
+        self.enc_model = hub.model(enc_key)
+        self.ingest_size = (
+            self.enc_model.preprocess.height,
+            self.enc_model.preprocess.width,
+        )
+        self.clip: deque[np.ndarray] = deque(maxlen=CLIP_LEN)
+        self.threshold = float(properties.get("threshold", 0.0))
+
+    def submit(self, ctx: FrameContext) -> Future | None:
+        frame = _resize_for_engine(ctx.frame, self.ingest_size)
+        return self.enc_engine.submit(frames=np.ascontiguousarray(frame))
+
+    def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
+        if result is None:
+            return [ctx]
+        self.clip.append(result)
+        if len(self.clip) < CLIP_LEN:
+            return [ctx]  # warm-up: no action tensor yet
+        clip = np.stack(self.clip)  # [T, D]
+        probs = self.dec_engine.submit(clips=clip).result()
+        lid = int(np.argmax(probs))
+        conf = float(probs[lid])
+        if conf >= self.threshold:
+            labels = self.dec_model.labels
+            ctx.tensors.append(
+                Tensor(
+                    name="action",
+                    confidence=conf,
+                    label_id=lid,
+                    label=labels[lid] if lid < len(labels) else str(lid),
+                    data=[float(x) for x in probs],
+                )
+            )
+        return [ctx]
+
+
+class AudioDetectStage(AsyncStage):
+    """gvaaudiodetect counterpart: classify 1-second 16 kHz windows
+    (reference pipelines/audio_detection/environment/pipeline.json:4-9,
+    sliding-window parameter :34-38)."""
+
+    WINDOW = 16000  # 1 s at 16 kHz
+
+    def __init__(self, name: str, model_key: str, properties: dict, hub: EngineHub):
+        self.name = name
+        self.threshold = float(properties.get("threshold", 0.0))
+        # sliding-window: stride as a fraction of the 1 s window
+        # (reference default 0.2, pipeline.json:34-38)
+        self.stride = max(1, int(self.WINDOW * float(properties.get("sliding-window", 0.2))))
+        self.engine = hub.engine(
+            "audio", model_key, properties.get("model-instance-id")
+        )
+        self.model = hub.model(model_key)
+        self._buffer = np.zeros(0, np.int16)
+        self._since_last = 0
+
+    def submit(self, ctx: FrameContext) -> Future | None:
+        if ctx.audio is None:
+            return None
+        self._buffer = np.concatenate([self._buffer, ctx.audio])[-self.WINDOW:]
+        self._since_last += len(ctx.audio)
+        if len(self._buffer) < self.WINDOW or self._since_last < self.stride:
+            return None
+        self._since_last = 0
+        return self.engine.submit(windows=self._buffer.copy())
+
+    def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
+        if result is None:
+            return [ctx]
+        lid = int(np.argmax(result))
+        conf = float(result[lid])
+        if conf >= self.threshold:
+            labels = self.model.labels
+            ctx.tensors.append(
+                Tensor(
+                    name="detection",
+                    confidence=conf,
+                    label_id=lid,
+                    label=labels[lid] if lid < len(labels) else str(lid),
+                )
+            )
+        return [ctx]

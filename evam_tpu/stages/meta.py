@@ -1,0 +1,125 @@
+"""Metadata serialization and delivery stages.
+
+``MetaconvertStage`` is the gvametaconvert counterpart: it renders the
+frame's regions/tensors/messages into the exact JSON schema the
+reference publishes (sample at reference charts/README.md:117-119):
+
+    {"objects": [{"detection": {"bounding_box": {"x_min": ..,
+     "y_min": .., "x_max": .., "y_max": ..}, "confidence": ..,
+     "label": "vehicle", "label_id": 2}, "h": 101, "w": 66, "x": 1,
+     "y": 56, "roi_type": "vehicle"}],
+     "resolution": {"height": 432, "width": 768},
+     "source": "<uri>", "timestamp": 49000000000}
+
+plus ``id`` when tracked, classification attributes as extra object
+keys, frame-level ``tensors`` (action/audio) with values inlined when
+``add-tensor-data`` is true (reference pipelines/action_recognition/
+general/pipeline.json:5), and UDF ``events``.
+
+``PublishStage`` hands the rendered metadata to the instance's
+destination (gvametapublish counterpart); ``SinkStage`` is the
+appsink: results land in the instance's client-visible queue
+(app_src_dst / app_dst pipelines, reference
+pipelines/object_detection/app_src_dst/pipeline.json:5)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from evam_tpu.stages.base import Stage
+from evam_tpu.stages.context import FrameContext, Region
+
+
+def region_to_object(region: Region, width: int, height: int) -> dict[str, Any]:
+    x, y, w, h = region.rect(width, height)
+    obj: dict[str, Any] = {
+        "detection": {
+            "bounding_box": {
+                "x_min": region.x0,
+                "y_min": region.y0,
+                "x_max": region.x1,
+                "y_max": region.y1,
+            },
+            "confidence": region.confidence,
+            "label": region.label,
+            "label_id": region.label_id,
+        },
+        "x": x,
+        "y": y,
+        "w": w,
+        "h": h,
+        "roi_type": region.label,
+    }
+    if region.object_id is not None:
+        obj["id"] = region.object_id
+    for tensor in region.tensors:
+        if tensor.is_detection:
+            continue
+        obj[tensor.name] = {
+            "label": tensor.label,
+            "label_id": tensor.label_id,
+            "confidence": tensor.confidence,
+        }
+    return obj
+
+
+class MetaconvertStage(Stage):
+    def __init__(self, name: str, properties: dict | None = None,
+                 source_uri: str = ""):
+        self.name = name
+        props = properties or {}
+        self.add_tensor_data = bool(props.get("add-tensor-data", False))
+        self.source_uri = source_uri
+
+    def process(self, ctx: FrameContext) -> list[FrameContext]:
+        meta: dict[str, Any] = {
+            "objects": [
+                region_to_object(r, ctx.width, ctx.height) for r in ctx.regions
+            ],
+            "resolution": {"height": ctx.height, "width": ctx.width},
+            "source": ctx.source_uri or self.source_uri,
+            "timestamp": ctx.pts_ns,
+        }
+        if ctx.tensors:
+            tensors = []
+            for t in ctx.tensors:
+                entry: dict[str, Any] = {
+                    "name": t.name,
+                    "label": t.label,
+                    "label_id": t.label_id,
+                    "confidence": t.confidence,
+                }
+                if self.add_tensor_data and t.data is not None:
+                    entry["data"] = t.data
+                tensors.append(entry)
+            meta["tensors"] = tensors
+        for message in ctx.messages:
+            # UDF-attached messages merge at top level, matching the
+            # reference's message handling (evas/publisher.py:198-201).
+            meta.update(message)
+        ctx.metadata = meta
+        return [ctx]
+
+
+class PublishStage(Stage):
+    def __init__(self, name: str,
+                 publish_fn: Callable[[FrameContext], None] | None = None):
+        self.name = name
+        self.publish_fn = publish_fn
+
+    def process(self, ctx: FrameContext) -> list[FrameContext]:
+        if self.publish_fn is not None and ctx.metadata is not None:
+            self.publish_fn(ctx)
+        return [ctx]
+
+
+class SinkStage(Stage):
+    def __init__(self, name: str,
+                 sink_fn: Callable[[FrameContext], None] | None = None):
+        self.name = name
+        self.sink_fn = sink_fn
+
+    def process(self, ctx: FrameContext) -> list[FrameContext]:
+        if self.sink_fn is not None:
+            self.sink_fn(ctx)
+        return [ctx]

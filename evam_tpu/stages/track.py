@@ -1,0 +1,114 @@
+"""Host-side multi-object tracker (gvatrack counterpart).
+
+The reference's gvatrack assigns persistent ``object_id``s visible in
+the published metadata (reference evas/publisher.py:210, parameter
+surface pipelines/object_tracking/person_vehicle_bike/
+pipeline.json:47-53). This is a vectorized-numpy IoU tracker
+(``tracking-type: iou``, the zero-copy short-term tracker class):
+greedy IoU matching per frame, new ids for unmatched detections,
+track expiry after ``max_age`` missed frames. Tracking state is
+per-stream host state — it never enters the jitted step, so stream
+isolation is preserved across batched TPU steps (SURVEY.md §7 "hard
+parts": tracking statefulness)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from evam_tpu.stages.base import Stage
+from evam_tpu.stages.context import FrameContext, Region
+
+
+def _iou_matrix_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+
+@dataclass
+class _Track:
+    track_id: int
+    box: np.ndarray
+    label_id: int
+    age: int = 0
+    hits: int = 1
+
+
+class IouTracker:
+    def __init__(self, iou_threshold: float = 0.3, max_age: int = 10):
+        self.iou_threshold = iou_threshold
+        self.max_age = max_age
+        self.tracks: list[_Track] = []
+        self._next_id = 1
+
+    def update(self, regions: list[Region]) -> None:
+        """Assign object_ids to regions in place."""
+        if self.tracks and regions:
+            det_boxes = np.stack([r.box for r in regions])
+            trk_boxes = np.stack([t.box for t in self.tracks])
+            iou = _iou_matrix_np(trk_boxes, det_boxes)
+            # class-gated: a person detection never continues a car track
+            for ti, t in enumerate(self.tracks):
+                for di, r in enumerate(regions):
+                    if r.label_id != t.label_id:
+                        iou[ti, di] = 0.0
+        else:
+            iou = np.zeros((len(self.tracks), len(regions)), np.float32)
+
+        matched_tracks: set[int] = set()
+        matched_dets: set[int] = set()
+        if iou.size:
+            order = np.dstack(np.unravel_index(np.argsort(-iou, axis=None), iou.shape))[0]
+            for ti, di in order:
+                if iou[ti, di] < self.iou_threshold:
+                    break
+                if ti in matched_tracks or di in matched_dets:
+                    continue
+                matched_tracks.add(int(ti))
+                matched_dets.add(int(di))
+                track = self.tracks[ti]
+                track.box = regions[di].box
+                track.age = 0
+                track.hits += 1
+                regions[di].object_id = track.track_id
+
+        for di, region in enumerate(regions):
+            if di in matched_dets:
+                continue
+            track = _Track(self._next_id, region.box, region.label_id)
+            self._next_id += 1
+            self.tracks.append(track)
+            region.object_id = track.track_id
+
+        survivors = []
+        for ti, track in enumerate(self.tracks):
+            if ti not in matched_tracks and track.track_id not in {
+                r.object_id for r in regions
+            }:
+                track.age += 1
+            if track.age <= self.max_age:
+                survivors.append(track)
+        self.tracks = survivors
+
+
+class TrackStage(Stage):
+    def __init__(self, name: str, properties: dict):
+        self.name = name
+        ttype = properties.get("tracking-type", "iou")
+        if ttype not in ("iou", "zero-term", "short-term", "zero-term-imageless",
+                        "short-term-imageless"):
+            raise ValueError(f"unsupported tracking-type '{ttype}'")
+        self.tracker = IouTracker(
+            iou_threshold=float(properties.get("iou-threshold", 0.3)),
+            max_age=int(properties.get("max-age", 10)),
+        )
+
+    def process(self, ctx: FrameContext) -> list[FrameContext]:
+        self.tracker.update(ctx.regions)
+        return [ctx]

@@ -1,0 +1,76 @@
+"""User-defined function stage (gvapython counterpart).
+
+The reference's gvapython element runs a user Python class inside the
+pipeline with ``module``, ``class`` and a JSON ``kwarg``
+(reference pipelines/object_detection/object_zone_count/
+pipeline.json:5-9, 44-65). Here the UDF API is:
+
+* a class with ``__init__(**kwarg)`` and
+  ``process_frame(ctx: FrameContext) -> bool | None`` — returning
+  False drops the frame;
+* or a module-level ``process_frame(ctx)`` function when no class is
+  given.
+
+Built-in extensions under ``evam_tpu.extensions`` mirror the
+reference's spatial-analytics extensions (zone count, line crossing,
+event convert)."""
+
+from __future__ import annotations
+
+import importlib
+
+from evam_tpu.obs import get_logger
+from evam_tpu.stages.base import Stage
+from evam_tpu.stages.context import FrameContext
+
+log = get_logger("stages.udf")
+
+
+class UdfStage(Stage):
+    def __init__(self, name: str, properties: dict):
+        self.name = name
+        module_name = properties.get("module")
+        if not module_name:
+            raise ValueError(f"udf stage '{name}' needs a 'module' property")
+        if module_name.endswith(".py"):
+            # path form, as the reference uses absolute .py paths;
+            # import under a unique name so same-stem files in
+            # different directories never collide.
+            module = _import_from_path(module_name)
+        else:
+            module = importlib.import_module(module_name)
+        class_name = properties.get("class")
+        kwarg = properties.get("kwarg", {}) or {}
+        if class_name:
+            self._impl = getattr(module, class_name)(**kwarg)
+            self._fn = self._impl.process_frame
+        else:
+            self._impl = None
+            self._fn = module.process_frame
+
+    def process(self, ctx: FrameContext) -> list[FrameContext]:
+        try:
+            keep = self._fn(ctx)
+        except Exception:  # noqa: BLE001 — a broken UDF must not kill the stream
+            log.exception("udf %s failed on frame %d", self.name, ctx.seq)
+            return [ctx]
+        return [] if keep is False else [ctx]
+
+
+def _import_from_path(path: str):
+    import hashlib
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    p = Path(path).resolve()
+    name = f"evam_udf_{p.stem}_{hashlib.sha1(str(p).encode()).hexdigest()[:8]}"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, p)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load UDF from {path}")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module

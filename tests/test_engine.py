@@ -1,0 +1,150 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from evam_tpu.engine import BatchEngine, EngineHub, DETECT_FIELDS
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def hub(eight_devices):
+    plan = build_mesh()  # 8 virtual CPU devices, data axis
+    registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                             width_overrides=NARROW)
+    hub = EngineHub(registry, plan=plan, max_batch=16, deadline_ms=5.0)
+    yield hub
+    hub.stop()
+
+
+def test_mesh_has_8_devices(hub):
+    assert hub.plan.data_size == 8
+    assert hub.plan.pad_batch(3) == 8
+    assert hub.plan.pad_batch(9) == 16
+
+
+def test_detect_engine_single_item(hub):
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    frame = np.random.default_rng(0).integers(0, 255, (64, 64, 3), np.uint8)
+    out = eng.submit(frames=frame).result(timeout=60)
+    assert out.shape == (32, DETECT_FIELDS)
+
+
+def test_detect_engine_batches_across_streams(hub):
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    rng = np.random.default_rng(1)
+    futs = [
+        eng.submit(frames=rng.integers(0, 255, (64, 64, 3), np.uint8))
+        for _ in range(24)
+    ]
+    outs = [f.result(timeout=60) for f in futs]
+    assert all(o.shape == (32, DETECT_FIELDS) for o in outs)
+    # the engine should have formed multi-item batches, not 24 singles
+    assert eng.stats.batches < 24
+
+
+def test_engine_bucket_padding(hub):
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    # buckets are multiples of the 8-device data axis
+    assert eng.buckets[0] == 8
+    assert eng._bucket(1) == 8
+    assert eng._bucket(9) == 16
+    assert eng._bucket(100) == 16  # capped at max_batch
+
+
+def test_engine_sharing_by_instance_id(hub):
+    a = hub.engine("detect", "object_detection/person_vehicle_bike", "shared-1")
+    b = hub.engine("detect", "object_detection/person_vehicle_bike", "shared-1")
+    c = hub.engine("detect", "object_detection/person_vehicle_bike", "other")
+    assert a is b
+    assert a is not c
+
+
+def test_classify_engine_rois(hub):
+    eng = hub.engine("classify", "object_classification/vehicle_attributes")
+    frame = np.random.default_rng(2).integers(0, 255, (64, 64, 3), np.uint8)
+    boxes = np.zeros((4, 4), np.float32)
+    boxes[0] = [0.1, 0.1, 0.5, 0.5]
+    out = eng.submit(frames=frame, boxes=boxes).result(timeout=60)
+    assert out.shape == (4, 11)  # 7 colors + 4 types
+    np.testing.assert_allclose(out[0, :7].sum(), 1.0, atol=1e-4)
+
+
+def test_audio_engine(hub):
+    eng = hub.engine("audio", "audio_detection/environment")
+    window = (np.random.default_rng(3).normal(0, 8000, 1600)).astype(np.int16)
+    out = eng.submit(windows=window).result(timeout=60)
+    assert out.shape == (53,)
+    np.testing.assert_allclose(out.sum(), 1.0, atol=1e-4)
+
+
+def test_action_engines(hub):
+    enc = hub.engine("action_encode", "action_recognition/encoder")
+    dec = hub.engine("action_decode", "action_recognition/decoder")
+    frame = np.random.default_rng(4).integers(0, 255, (64, 64, 3), np.uint8)
+    emb = enc.submit(frames=frame).result(timeout=60)
+    assert emb.shape == (512,)
+    clip = np.stack([emb] * 16)
+    probs = dec.submit(clips=clip).result(timeout=60)
+    assert probs.shape == (400,)
+
+
+def test_engine_concurrent_submitters(hub):
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    errors = []
+    results = []
+    lock = threading.Lock()
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(5):
+                out = eng.submit(
+                    frames=rng.integers(0, 255, (64, 64, 3), np.uint8)
+                ).result(timeout=60)
+                with lock:
+                    results.append(out)
+        except Exception as exc:  # noqa: BLE001
+            with lock:
+                errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 40
+
+
+def test_engine_rejects_wrong_inputs(hub):
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    with pytest.raises(ValueError):
+        eng.submit(bogus=np.zeros((4, 4, 3), np.uint8))
+
+
+def test_engine_stop_rejects_new_work():
+    registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                             width_overrides=NARROW)
+    eng = BatchEngine(
+        "t", lambda p, x: x.sum(axis=(1, 2, 3)).astype(np.float32),
+        params={}, max_batch=4, input_names=("x",),
+    )
+    out = eng.submit(x=np.ones((2, 2, 3), np.uint8)).result(timeout=30)
+    assert float(out) == 12.0
+    eng.stop()
+    with pytest.raises(RuntimeError):
+        eng.submit(x=np.ones((2, 2, 3), np.uint8))
+
+
+def test_hub_stats(hub):
+    stats = hub.stats()
+    det = stats["detect:object_detection/person_vehicle_bike"]
+    assert det["items"] >= 25
+    assert 0 < det["mean_occupancy"] <= 1.0

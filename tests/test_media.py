@@ -1,0 +1,121 @@
+import time
+
+import numpy as np
+import pytest
+
+from evam_tpu.media import DecodeWorker, FileSource, SyntheticSource, create_source
+from evam_tpu.media.audio import SyntheticAudioSource
+from evam_tpu.media.source import AppSource
+
+
+def test_synthetic_source_deterministic():
+    a = list(SyntheticSource(width=64, height=48, count=5).frames())
+    b = list(SyntheticSource(width=64, height=48, count=5).frames())
+    assert len(a) == 5
+    for ea, eb in zip(a, b):
+        np.testing.assert_array_equal(ea.frame, eb.frame)
+    assert a[1].pts_ns - a[0].pts_ns == int(1e9 / 30)
+
+
+def test_synthetic_uri_parsing():
+    src = SyntheticSource.from_uri("synthetic://320x240@15?count=7&seed=3")
+    assert (src.width, src.height, src.fps, src.count, src.seed) == (320, 240, 15.0, 7, 3)
+
+
+def test_file_source_roundtrip(tmp_path):
+    import cv2
+
+    path = str(tmp_path / "clip.mp4")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 12, (64, 48))
+    for i in range(10):
+        w.write(np.full((48, 64, 3), i * 20, np.uint8))
+    w.release()
+    events = list(FileSource(path).frames())
+    assert len(events) == 10
+    assert events[0].frame.shape == (48, 64, 3)
+
+
+def test_create_source_types():
+    assert isinstance(
+        create_source({"uri": "synthetic://64x48@30?count=1", "type": "uri"}),
+        SyntheticSource,
+    )
+    assert isinstance(
+        create_source({"uri": "synthetic-audio://seconds=1", "type": "uri"}),
+        SyntheticAudioSource,
+    )
+    assert isinstance(create_source({"type": "application"}), AppSource)
+    with pytest.raises(ValueError):
+        create_source({"type": "gige"})
+
+
+def test_decode_worker_queue_and_eos():
+    worker = DecodeWorker(
+        "s1", lambda: SyntheticSource(width=64, height=48, count=12), maxsize=32
+    ).start()
+    frames = []
+    while True:
+        ev = worker.queue.get(timeout=10)
+        if ev is None:
+            break
+        frames.append(ev)
+    assert len(frames) == 12
+    assert worker.frames_decoded == 12
+    assert worker.finished
+
+
+def test_decode_worker_drops_when_full():
+    worker = DecodeWorker(
+        "s2", lambda: SyntheticSource(width=64, height=48, count=50), maxsize=4
+    ).start()
+    time.sleep(1.0)  # let it decode everything into the size-4 queue
+    assert worker.frames_dropped > 0
+    worker.stop()
+
+
+def test_decode_worker_restarts_on_error():
+    calls = {"n": 0}
+
+    class FlakySource:
+        def __init__(self):
+            calls["n"] += 1
+            self.fail = calls["n"] == 1
+
+        def frames(self):
+            if self.fail:
+                raise IOError("transient")
+            yield from SyntheticSource(width=32, height=32, count=3).frames()
+
+        def close(self):
+            pass
+
+    worker = DecodeWorker(
+        "s3", FlakySource, max_restarts=2, restart_backoff_s=0.01
+    ).start()
+    events = []
+    while True:
+        ev = worker.queue.get(timeout=10)
+        if ev is None:
+            break
+        events.append(ev)
+    assert calls["n"] == 2  # failed once, restarted once
+    assert len(events) == 3
+    assert worker.error == "transient"
+
+
+def test_app_source_push():
+    src = AppSource()
+    src.push(np.zeros((8, 8, 3), np.uint8), pts_ns=123)
+    src.push_raw(b"\x01" * (8 * 8 * 3), 8, 8)
+    src.end()
+    events = list(src.frames())
+    assert len(events) == 2
+    assert events[0].pts_ns == 123
+    assert events[1].frame[0, 0, 0] == 1
+
+
+def test_audio_synthetic_chunks():
+    events = list(SyntheticAudioSource(seconds=1.0).frames())
+    assert len(events) == 10  # 100ms chunks
+    assert events[0].audio.shape == (1600,)
+    assert events[0].audio.dtype == np.int16

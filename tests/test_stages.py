@@ -1,0 +1,225 @@
+import json
+
+import numpy as np
+import pytest
+
+from evam_tpu.engine import EngineHub
+from evam_tpu.graph import PipelineLoader, resolve_parameters
+from evam_tpu.media import DecodeWorker, SyntheticSource
+from evam_tpu.media.audio import SyntheticAudioSource
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+from evam_tpu.stages import StreamRunner, build_stages
+from evam_tpu.stages.context import FrameContext, Region
+from evam_tpu.stages.track import IouTracker
+from evam_tpu.stages.meta import MetaconvertStage
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def hub(eight_devices):
+    registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                             width_overrides=NARROW)
+    hub = EngineHub(registry, plan=build_mesh(), max_batch=16, deadline_ms=4.0)
+    yield hub
+    hub.stop()
+
+
+@pytest.fixture(scope="module")
+def loader():
+    return PipelineLoader(REPO / "pipelines")
+
+
+def _run_pipeline(loader, hub, name, version, params=None, count=8,
+                  source=None, sink=None):
+    spec = loader.get(name, version)
+    stages_spec, _ = resolve_parameters(spec, params or {})
+    outputs = []
+    runner = StreamRunner(
+        "test",
+        build_stages(
+            stages_spec,
+            hub,
+            source_uri="synthetic://test",
+            publish_fn=lambda ctx: outputs.append(ctx.metadata),
+            sink_fn=sink,
+        ),
+        source_uri="synthetic://test",
+    )
+    src = source or SyntheticSource(width=96, height=64, count=count)
+    runner.run(src.frames())
+    return runner, outputs
+
+
+def test_detection_pipeline_end_to_end(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_detection", "person_vehicle_bike",
+        {"threshold": 0.0}, count=8,
+    )
+    assert runner.frames_in == 8
+    assert runner.frames_out == 8
+    assert len(outputs) == 8
+    meta = outputs[0]
+    # exact reference metadata schema (charts/README.md:117)
+    assert set(meta) >= {"objects", "resolution", "source", "timestamp"}
+    assert meta["resolution"] == {"height": 64, "width": 96}
+    assert meta["timestamp"] == 0
+    assert outputs[1]["timestamp"] == int(1e9 / 30)
+    for obj in meta["objects"]:
+        det = obj["detection"]
+        assert set(det["bounding_box"]) == {"x_min", "y_min", "x_max", "y_max"}
+        assert {"confidence", "label", "label_id"} <= set(det)
+        assert {"x", "y", "w", "h", "roi_type"} <= set(obj)
+    assert json.dumps(meta)  # serializable
+
+
+def test_metadata_threshold_filters(loader, hub):
+    _, all_out = _run_pipeline(
+        loader, hub, "object_detection", "person_vehicle_bike",
+        {"threshold": 0.0}, count=4,
+    )
+    _, none_out = _run_pipeline(
+        loader, hub, "object_detection", "person_vehicle_bike",
+        {"threshold": 1.0}, count=4,
+    )
+    n_all = sum(len(m["objects"]) for m in all_out)
+    n_none = sum(len(m["objects"]) for m in none_out)
+    assert n_none == 0
+    assert n_all >= n_none
+
+
+def test_classification_pipeline(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_classification", "vehicle_attributes",
+        {"detection-threshold": 0.0, "object-class": ""}, count=4,
+    )
+    assert len(outputs) == 4
+    attrs = [
+        obj for meta in outputs for obj in meta["objects"] if "color" in obj
+    ]
+    assert attrs, "classification attributes attached to objects"
+    a = attrs[0]["color"]
+    assert {"label", "label_id", "confidence"} <= set(a)
+    assert a["label"] in ["white", "gray", "yellow", "red", "green", "blue", "black"]
+
+
+def test_tracking_pipeline_assigns_ids(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_tracking", "person_vehicle_bike",
+        {"detection-threshold": 0.0, "object-class": ""}, count=6,
+    )
+    ids = [
+        obj.get("id") for meta in outputs for obj in meta["objects"]
+    ]
+    assert any(i is not None for i in ids)
+
+
+def test_iou_tracker_persistence():
+    tracker = IouTracker()
+    r1 = Region(0.1, 0.1, 0.3, 0.3, 0.9, 1, "person")
+    tracker.update([r1])
+    tid = r1.object_id
+    assert tid is not None
+    # same object moved slightly: keeps id
+    r2 = Region(0.12, 0.11, 0.32, 0.31, 0.9, 1, "person")
+    tracker.update([r2])
+    assert r2.object_id == tid
+    # different class at same spot: new id
+    r3 = Region(0.12, 0.11, 0.32, 0.31, 0.9, 2, "vehicle")
+    tracker.update([r3])
+    assert r3.object_id != tid
+
+
+def test_zone_count_udf(loader, hub):
+    zones = {"zones": [{"name": "everywhere",
+                        "polygon": [[0, 0], [1, 0], [1, 1], [0, 1]]}]}
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_detection", "object_zone_count",
+        {"threshold": 0.0, "object-zone-count-config": zones}, count=4,
+    )
+    events = [e for m in outputs for e in m.get("events", [])]
+    assert events
+    assert events[0]["event-type"] == "zone-count"
+    assert events[0]["zone-name"] == "everywhere"
+    assert events[0]["zone-count"] >= 1
+
+
+def test_action_pipeline_emits_after_clip(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "action_recognition", "general", {}, count=20,
+    )
+    assert len(outputs) == 20
+    early = [m for m in outputs[:15] if "tensors" in m]
+    late = [m for m in outputs[16:] if "tensors" in m]
+    assert not early  # clip warm-up: no action before 16 frames
+    assert late
+    t = late[0]["tensors"][0]
+    assert t["name"] == "action"
+    assert "data" in t  # add-tensor-data=true inlines values
+    assert len(t["data"]) == 400
+
+
+def test_audio_pipeline(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "audio_detection", "environment",
+        {"threshold": 0.0, "sliding-window": 1.0}, count=0,
+        source=SyntheticAudioSource(seconds=3.0),
+    )
+    with_det = [m for m in outputs if m.get("tensors")]
+    assert with_det, "audio events detected"
+    t = with_det[0]["tensors"][0]
+    assert t["name"] == "detection"
+    assert t["label"].startswith("sound_")
+
+
+def test_decode_only_pipeline(loader, hub):
+    frames = []
+    runner, _ = _run_pipeline(
+        loader, hub, "video_decode", "app_dst", {}, count=5,
+        sink=lambda ctx: frames.append(ctx.frame),
+    )
+    assert len(frames) == 5
+    assert frames[0].shape == (64, 96, 3)
+
+
+def test_app_src_dst_pipeline(loader, hub):
+    results = []
+    runner, _ = _run_pipeline(
+        loader, hub, "object_detection", "app_src_dst", {}, count=4,
+        sink=lambda ctx: results.append((ctx.frame, list(ctx.regions))),
+    )
+    assert len(results) == 4
+
+
+def test_runner_window_overlap(loader, hub):
+    # the runner must keep multiple frames in flight
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_detection", "person_vehicle_bike",
+        {"threshold": 0.0}, count=16,
+    )
+    eng = hub.engine("detect", "object_detection/person_vehicle_bike")
+    assert runner.frames_out == 16
+
+
+def test_inference_interval_reuses_regions(loader, hub):
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_detection", "person_vehicle_bike",
+        {"threshold": 0.0, "inference-interval": 4}, count=8,
+    )
+    assert len(outputs) == 8  # every frame still published
+
+
+def test_metaconvert_merges_messages():
+    stage = MetaconvertStage("mc", {}, source_uri="s")
+    ctx = FrameContext(
+        frame=np.zeros((10, 10, 3), np.uint8), pts_ns=5, seq=0, stream_id="x"
+    )
+    ctx.messages.append({"events": [{"event-type": "zone-count"}]})
+    out = stage.process(ctx)[0]
+    assert out.metadata["events"][0]["event-type"] == "zone-count"
+    assert out.metadata["source"] == "s"
