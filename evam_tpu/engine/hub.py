@@ -21,11 +21,11 @@ from evam_tpu.parallel.mesh import MeshPlan
 log = get_logger("engine.hub")
 
 _BUILDERS = {
-    "detect": (step_builders.build_detect_step, ("frames",)),
-    "classify": (step_builders.build_classify_step, ("frames", "boxes")),
-    "action_encode": (step_builders.build_action_encode_step, ("frames",)),
-    "action_decode": (step_builders.build_action_decode_step, ("clips",)),
-    "audio": (step_builders.build_audio_step, ("windows",)),
+    "detect": (step_builders.build_detect_step, ("frames",), True),
+    "classify": (step_builders.build_classify_step, ("frames", "boxes"), True),
+    "action_encode": (step_builders.build_action_encode_step, ("frames",), True),
+    "action_decode": (step_builders.build_action_decode_step, ("clips",), False),
+    "audio": (step_builders.build_audio_step, ("windows",), False),
 }
 
 
@@ -38,11 +38,15 @@ class EngineHub:
         plan: MeshPlan | None = None,
         max_batch: int = 32,
         deadline_ms: float = 8.0,
+        wire_format: str = "i420",
     ):
         self.registry = registry
         self.plan = plan
         self.max_batch = max_batch
         self.deadline_ms = deadline_ms
+        #: host→device frame encoding for video engines ("i420" halves
+        #: ingest bandwidth; see evam_tpu.ops.color)
+        self.wire_format = wire_format
         self._engines: dict[str, BatchEngine] = {}
         self._models: dict[str, LoadedModel] = {}
         # RLock: engine() calls model() while holding the lock.
@@ -72,7 +76,9 @@ class EngineHub:
         with self._lock:
             if key not in self._engines:
                 model = self.model(model_key)
-                builder, input_names = _BUILDERS[kind]
+                builder, input_names, wired = _BUILDERS[kind]
+                if wired:
+                    builder_kwargs.setdefault("wire_format", self.wire_format)
                 step_fn = builder(model, **builder_kwargs)
                 self._engines[key] = BatchEngine(
                     name=key,
@@ -84,6 +90,36 @@ class EngineHub:
                     input_names=input_names,
                 )
                 log.info("created engine %s (model %s)", key, model_key)
+            return self._engines[key]
+
+    def fused_engine(
+        self,
+        det_key: str,
+        cls_key: str,
+        instance_id: str | None = None,
+        **builder_kwargs,
+    ) -> BatchEngine:
+        """Fused detect+classify engine: one upload, one readback per
+        frame (see steps.build_detect_classify_step)."""
+        key = f"detect_classify:{instance_id or det_key + '+' + cls_key}"
+        with self._lock:
+            if key not in self._engines:
+                det = self.model(det_key)
+                cls = self.model(cls_key)
+                builder_kwargs.setdefault("wire_format", self.wire_format)
+                step_fn = step_builders.build_detect_classify_step(
+                    det, cls, **builder_kwargs
+                )
+                self._engines[key] = BatchEngine(
+                    name=key,
+                    step_fn=step_fn,
+                    params={"det": det.params, "cls": cls.params},
+                    plan=self.plan,
+                    max_batch=self.max_batch,
+                    deadline_ms=self.deadline_ms,
+                    input_names=("frames",),
+                )
+                log.info("created fused engine %s", key)
             return self._engines[key]
 
     def stats(self) -> dict[str, dict]:

@@ -23,13 +23,49 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+import dataclasses
+
 from evam_tpu.models.registry import LoadedModel
 from evam_tpu.ops.boxes import decode_boxes
 from evam_tpu.ops.nms import batched_nms
-from evam_tpu.ops.preprocess import crop_rois, preprocess_batch
+from evam_tpu.ops.preprocess import (
+    crop_rois,
+    decode_wire,
+    preprocess_batch,
+    preprocess_bgr,
+)
 
 #: Packed detection row layout: [x0, y0, x1, y1, score, label, valid]
 DETECT_FIELDS = 7
+
+
+def _wired(model: LoadedModel, wire_format: str):
+    return dataclasses.replace(model.preprocess, wire_format=wire_format)
+
+
+def _detect_packed(params, bgr, model, anchors, max_detections,
+                   iou_threshold, score_threshold):
+    x = preprocess_bgr(bgr, model.preprocess)
+    out = model.forward(params, x)
+    boxes = decode_boxes(out["loc"].astype(jnp.float32), anchors)
+    scores = jax.nn.softmax(out["conf"].astype(jnp.float32), axis=-1)
+    bx, sc, lb, valid = batched_nms(
+        boxes,
+        scores,
+        max_outputs=max_detections,
+        iou_threshold=iou_threshold,
+        score_threshold=score_threshold,
+    )
+    packed = jnp.concatenate(
+        [
+            bx,
+            sc[..., None],
+            lb[..., None].astype(jnp.float32),
+            valid[..., None].astype(jnp.float32),
+        ],
+        axis=-1,
+    )
+    return packed, bx
 
 
 def build_detect_step(
@@ -37,38 +73,74 @@ def build_detect_step(
     max_detections: int = 32,
     iou_threshold: float = 0.45,
     score_threshold: float = 0.3,
+    wire_format: str = "bgr",
 ) -> Callable:
-    """uint8 frames [B,H,W,3] → packed detections [B,K,7] float32."""
+    """Wire-encoded uint8 frames → packed detections [B,K,7] float32."""
     anchors = jnp.asarray(model.anchors)
-    preproc = model.preprocess
-    forward = model.forward
 
     def step(params, frames):
-        x = preprocess_batch(frames, preproc)
-        out = forward(params, x)
-        boxes = decode_boxes(out["loc"].astype(jnp.float32), anchors)
-        scores = jax.nn.softmax(out["conf"].astype(jnp.float32), axis=-1)
-        bx, sc, lb, valid = batched_nms(
-            boxes,
-            scores,
-            max_outputs=max_detections,
-            iou_threshold=iou_threshold,
-            score_threshold=score_threshold,
+        bgr = decode_wire(frames, wire_format)
+        packed, _ = _detect_packed(
+            params, bgr, model, anchors, max_detections,
+            iou_threshold, score_threshold,
         )
-        return jnp.concatenate(
-            [
-                bx,
-                sc[..., None],
-                lb[..., None].astype(jnp.float32),
-                valid[..., None].astype(jnp.float32),
-            ],
-            axis=-1,
-        )
+        return packed
 
     return step
 
 
-def build_classify_step(model: LoadedModel, roi_budget: int = 8) -> Callable:
+def build_detect_classify_step(
+    det_model: LoadedModel,
+    cls_model: LoadedModel,
+    max_detections: int = 32,
+    roi_budget: int = 8,
+    iou_threshold: float = 0.45,
+    score_threshold: float = 0.3,
+    wire_format: str = "bgr",
+) -> Callable:
+    """Fused gvadetect+gvaclassify: ONE frame upload, ONE readback.
+
+    The reference runs detection and classification as separate
+    engines with the frame crossing the CPU pipeline between them
+    (pipelines/object_classification/vehicle_attributes/
+    pipeline.json:4-5); fusing them into one XLA program keeps the
+    decoded frame in HBM: preprocess → SSD → NMS → on-device ROI crop
+    of the top-R boxes → classifier — one jit. Output
+    [B, K, 7 + total_classes]: packed detections, with per-head
+    probability vectors for the first ``roi_budget`` rows.
+    """
+    anchors = jnp.asarray(det_model.anchors)
+    head_total = sum(n for _, n in cls_model.spec.heads)
+    cls_pre = cls_model.preprocess
+
+    def step(params, frames):
+        bgr = decode_wire(frames, wire_format)
+        packed, bx = _detect_packed(
+            params["det"], bgr, det_model, anchors, max_detections,
+            iou_threshold, score_threshold,
+        )
+        b = bgr.shape[0]
+        roi_boxes = bx[:, :roi_budget, :]  # NMS output is score-sorted
+        crops = crop_rois(bgr, roi_boxes, (cls_pre.height, cls_pre.width))
+        crops = crops.reshape((b * roi_budget,) + crops.shape[2:])
+        cls_in = preprocess_bgr(crops, cls_pre)
+        out = cls_model.forward(params["cls"], cls_in)
+        probs = jnp.concatenate(
+            [
+                jax.nn.softmax(out[name].astype(jnp.float32), axis=-1)
+                for name, _ in cls_model.spec.heads
+            ],
+            axis=-1,
+        ).reshape(b, roi_budget, head_total)
+        pad = jnp.zeros((b, packed.shape[1] - roi_budget, head_total), jnp.float32)
+        return jnp.concatenate([packed, jnp.concatenate([probs, pad], axis=1)], axis=-1)
+
+    return step
+
+
+def build_classify_step(
+    model: LoadedModel, roi_budget: int = 8, wire_format: str = "bgr"
+) -> Callable:
     """Frames + ROI boxes → packed per-ROI head probabilities.
 
     ``frames`` uint8 [B,H,W,3]; ``boxes`` float32 [B,R,4] normalized
@@ -84,9 +156,10 @@ def build_classify_step(model: LoadedModel, roi_budget: int = 8) -> Callable:
 
     def step(params, frames, boxes):
         b, r = boxes.shape[:2]
-        crops = crop_rois(frames, boxes, (preproc.height, preproc.width))
-        crops = crops.reshape((b * r,) + crops.shape[2:]).astype(jnp.uint8)
-        x = preprocess_batch(crops, preproc)
+        bgr = decode_wire(frames, wire_format)
+        crops = crop_rois(bgr, boxes, (preproc.height, preproc.width))
+        crops = crops.reshape((b * r,) + crops.shape[2:])
+        x = preprocess_bgr(crops, preproc)
         out = forward(params, x)  # dict head -> [B*R, n]
         probs = [
             jax.nn.softmax(out[name].astype(jnp.float32), axis=-1)
@@ -98,13 +171,15 @@ def build_classify_step(model: LoadedModel, roi_budget: int = 8) -> Callable:
     return step
 
 
-def build_action_encode_step(model: LoadedModel) -> Callable:
-    """uint8 frames [B,H,W,3] → embeddings [B,D] float32."""
+def build_action_encode_step(
+    model: LoadedModel, wire_format: str = "bgr"
+) -> Callable:
+    """Wire-encoded uint8 frames → embeddings [B,D] float32."""
     preproc = model.preprocess
     forward = model.forward
 
     def step(params, frames):
-        x = preprocess_batch(frames, preproc)
+        x = preprocess_bgr(decode_wire(frames, wire_format), preproc)
         return forward(params, x).astype(jnp.float32)
 
     return step

@@ -34,6 +34,9 @@ class PreprocessSpec:
     #: if True keep 0..255 range instead of 0..1 (OpenVINO-style nets)
     raw_range: bool = True
     dtype: str = "bfloat16"
+    #: host→device wire format: "bgr" ([B,H,W,3]) or "i420"
+    #: ([B,H*3/2,W], half the bytes — see evam_tpu.ops.color)
+    wire_format: str = "bgr"
 
 
 def preprocess_batch(frames: jax.Array, spec: PreprocessSpec) -> jax.Array:
@@ -46,10 +49,22 @@ def preprocess_batch(frames: jax.Array, spec: PreprocessSpec) -> jax.Array:
     """
     if frames.dtype != jnp.uint8:
         raise ValueError(f"expected uint8 frames, got {frames.dtype}")
-    b, h, w, c = frames.shape
-    out_dtype = jnp.dtype(spec.dtype)
+    return preprocess_bgr(decode_wire(frames, spec.wire_format), spec)
 
-    x = frames.astype(jnp.float32)
+
+def decode_wire(frames: jax.Array, wire_format: str) -> jax.Array:
+    """Wire-encoded uint8 batch → float32 BGR [B, H, W, 3]."""
+    if wire_format == "i420":
+        from evam_tpu.ops.color import i420_to_bgr
+
+        return i420_to_bgr(frames)
+    return frames.astype(jnp.float32)
+
+
+def preprocess_bgr(x: jax.Array, spec: PreprocessSpec) -> jax.Array:
+    """float32 BGR [B, H, W, 3] → model input per *spec*."""
+    out_dtype = jnp.dtype(spec.dtype)
+    b, h, w, c = x.shape
     if spec.color_space.upper() == "RGB":
         x = x[..., ::-1]  # BGR (decode convention) → RGB
 

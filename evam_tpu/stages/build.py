@@ -19,11 +19,28 @@ from evam_tpu.stages.infer import (
     AudioDetectStage,
     ClassifyStage,
     DetectStage,
+    FusedDetectClassifyStage,
 )
 from evam_tpu.stages.meta import MetaconvertStage, PublishStage, SinkStage
 from evam_tpu.stages.misc import AudioMixStage, ConvertStage, LevelStage
 from evam_tpu.stages.track import TrackStage
 from evam_tpu.stages.udf import UdfStage
+
+
+def _fusable(specs: list[StageSpec]) -> tuple[int, int] | None:
+    """Find (detect_idx, classify_idx) fusable into one engine pass:
+    a detect stage whose following stages up to a classify are only
+    track/convert (order-insensitive host stages)."""
+    for i, spec in enumerate(specs):
+        if spec.kind != StageKind.DETECT:
+            continue
+        for j in range(i + 1, len(specs)):
+            kind = specs[j].kind
+            if kind == StageKind.CLASSIFY:
+                return (i, j)
+            if kind not in (StageKind.TRACK, StageKind.CONVERT):
+                break
+    return None
 
 
 def build_stages(
@@ -32,14 +49,34 @@ def build_stages(
     source_uri: str = "",
     publish_fn: Callable[[FrameContext], None] | None = None,
     sink_fn: Callable[[FrameContext], None] | None = None,
+    fuse: bool = True,
 ) -> list[Stage]:
+    specs = list(specs)
+    fused: FusedDetectClassifyStage | None = None
+    if fuse:
+        pair = _fusable(specs)
+        if pair is not None:
+            di, ci = pair
+            det, cls = specs[di], specs[ci]
+            fused = FusedDetectClassifyStage(
+                f"{det.name}+{cls.name}",
+                det.model, cls.model,
+                det.properties, cls.properties, hub,
+            )
+            specs = [s for k, s in enumerate(specs) if k != ci]
+
     stages: list[Stage] = []
     for spec in specs:
         kind = spec.kind
         if kind in (StageKind.SOURCE, StageKind.DECODE):
             continue  # handled by the StreamInstance's DecodeWorker
         if kind == StageKind.DETECT:
-            stages.append(DetectStage(spec.name, spec.model, spec.properties, hub))
+            if fused is not None:
+                stages.append(fused)
+            else:
+                stages.append(
+                    DetectStage(spec.name, spec.model, spec.properties, hub)
+                )
         elif kind == StageKind.CLASSIFY:
             stages.append(ClassifyStage(spec.name, spec.model, spec.properties, hub))
         elif kind == StageKind.TRACK:

@@ -43,6 +43,15 @@ def _resize_for_engine(frame: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     return cv2.resize(frame, (w, h), interpolation=cv2.INTER_LINEAR)
 
 
+def _encode_wire(frame_bgr: np.ndarray, wire_format: str) -> np.ndarray:
+    """Host-side wire encoding (decode-thread side of ops.color)."""
+    if wire_format == "i420":
+        from evam_tpu.ops.color import bgr_to_i420_host
+
+        return bgr_to_i420_host(frame_bgr)
+    return np.ascontiguousarray(frame_bgr)
+
+
 class DetectStage(AsyncStage):
     """gvadetect counterpart. Properties (reference
     pipelines/object_detection/person_vehicle_bike/pipeline.json:18-40):
@@ -66,6 +75,7 @@ class DetectStage(AsyncStage):
             score_threshold=ENGINE_SCORE_FLOOR,
         )
         self.model = hub.model(model_key)
+        self.wire = hub.wire_format
         self.ingest_size = (self.model.preprocess.height, self.model.preprocess.width)
         self._count = 0
         self._last_regions: list[Region] = []
@@ -75,7 +85,7 @@ class DetectStage(AsyncStage):
         if (self._count - 1) % self.interval:
             return None  # inference-interval skip: reuse last regions
         frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.engine.submit(frames=np.ascontiguousarray(frame))
+        return self.engine.submit(frames=_encode_wire(frame, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -123,6 +133,7 @@ class ClassifyStage(AsyncStage):
         self.object_class = properties.get("object-class")
         self.interval = max(1, int(properties.get("reclassify-interval", 1)))
         self.threshold = float(properties.get("threshold", 0.0))
+        self.wire = hub.wire_format
         self.engine = hub.engine(
             "classify",
             model_key,
@@ -154,7 +165,7 @@ class ClassifyStage(AsyncStage):
         for i, r in enumerate(regions):
             boxes[i] = [r.x0, r.y0, r.x1, r.y1]
         frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.engine.submit(frames=np.ascontiguousarray(frame), boxes=boxes)
+        return self.engine.submit(frames=_encode_wire(frame, self.wire), boxes=boxes)
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -204,10 +215,11 @@ class ActionStage(AsyncStage):
         )
         self.clip: deque[np.ndarray] = deque(maxlen=CLIP_LEN)
         self.threshold = float(properties.get("threshold", 0.0))
+        self.wire = hub.wire_format
 
     def submit(self, ctx: FrameContext) -> Future | None:
         frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.enc_engine.submit(frames=np.ascontiguousarray(frame))
+        return self.enc_engine.submit(frames=_encode_wire(frame, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -278,4 +290,103 @@ class AudioDetectStage(AsyncStage):
                     label=labels[lid] if lid < len(labels) else str(lid),
                 )
             )
+        return [ctx]
+
+
+class FusedDetectClassifyStage(AsyncStage):
+    """Detect+classify fused into one engine round-trip.
+
+    Produced by the stage builder's fusion pass when a classify stage
+    follows detect in the chain (the standard object_classification /
+    object_tracking templates): one frame upload and one packed
+    readback replace two of each, doubling effective ingest bandwidth
+    — the scarce resource on the host→TPU path. Classification probs
+    arrive for the top-R detections regardless of class; the
+    ``object-class`` filter decides host-side which regions get
+    attributes (matching gvaclassify's filter semantics)."""
+
+    ROI_BUDGET = 8
+
+    def __init__(
+        self,
+        name: str,
+        det_key: str,
+        cls_key: str,
+        det_props: dict,
+        cls_props: dict,
+        hub: EngineHub,
+    ):
+        self.name = name
+        self.det_threshold = float(det_props.get("threshold", 0.5))
+        self.cls_threshold = float(cls_props.get("threshold", 0.0))
+        self.object_class = cls_props.get("object-class")
+        self.interval = max(1, int(det_props.get("inference-interval", 1)))
+        self.engine = hub.fused_engine(
+            det_key,
+            cls_key,
+            det_props.get("model-instance-id"),
+            roi_budget=self.ROI_BUDGET,
+            score_threshold=ENGINE_SCORE_FLOOR,
+        )
+        self.det_model = hub.model(det_key)
+        self.cls_model = hub.model(cls_key)
+        self.wire = hub.wire_format
+        self.ingest_size = (
+            self.det_model.preprocess.height,
+            self.det_model.preprocess.width,
+        )
+        self._count = 0
+        self._last_regions: list[Region] = []
+
+    def submit(self, ctx: FrameContext) -> Future | None:
+        self._count += 1
+        if (self._count - 1) % self.interval:
+            return None
+        frame = _resize_for_engine(ctx.frame, self.ingest_size)
+        return self.engine.submit(frames=_encode_wire(frame, self.wire))
+
+    def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
+        if result is None:
+            ctx.regions.extend(copy.deepcopy(self._last_regions))
+            return [ctx]
+        det_labels = self.det_model.labels
+        head_slices = []
+        offset = 7
+        for head_name, n in self.cls_model.spec.heads:
+            head_slices.append((head_name, offset, offset + n))
+            offset += n
+        regions = []
+        for i, row in enumerate(result):
+            x0, y0, x1, y1, score, label_id, valid = row[:7]
+            if valid < 0.5 or score < self.det_threshold:
+                continue
+            lid = int(label_id)
+            label = det_labels[lid] if 0 <= lid < len(det_labels) else str(lid)
+            region = Region(
+                x0=float(x0), y0=float(y0), x1=float(x1), y1=float(y1),
+                confidence=float(score), label_id=lid, label=label,
+            )
+            region.tensors.append(
+                Tensor(name="detection", confidence=float(score),
+                       label_id=lid, label=label, is_detection=True)
+            )
+            if i < self.ROI_BUDGET and self.object_class in (None, "", label):
+                for head_name, a, b in head_slices:
+                    probs = row[a:b]
+                    hid = int(np.argmax(probs))
+                    conf = float(probs[hid])
+                    if conf < self.cls_threshold:
+                        continue
+                    label_list = self.cls_model.head_labels.get(head_name, [])
+                    region.tensors.append(
+                        Tensor(
+                            name=head_name,
+                            confidence=conf,
+                            label_id=hid,
+                            label=label_list[hid] if hid < len(label_list) else str(hid),
+                        )
+                    )
+            regions.append(region)
+        self._last_regions = regions
+        ctx.regions.extend(regions)
         return [ctx]

@@ -18,7 +18,9 @@ def hub(eight_devices):
     plan = build_mesh()  # 8 virtual CPU devices, data axis
     registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
                              width_overrides=NARROW)
-    hub = EngineHub(registry, plan=plan, max_batch=16, deadline_ms=5.0)
+    # raw-BGR wire: these tests drive engines directly with [H,W,3] arrays
+    hub = EngineHub(registry, plan=plan, max_batch=16, deadline_ms=5.0,
+                    wire_format="bgr")
     yield hub
     hub.stop()
 

@@ -122,3 +122,29 @@ def test_crop_rois():
     assert crops.shape == (1, 2, 8, 8, 3)
     assert float(crops[0, 0].min()) > 200.0  # white region
     assert float(crops[0, 1].max()) == 0.0  # black region
+
+
+def test_i420_roundtrip_matches_cv2():
+    import cv2
+    from evam_tpu.ops.color import bgr_to_i420_host, i420_to_bgr
+
+    rng = np.random.default_rng(7)
+    bgr = rng.integers(0, 255, (32, 48, 3), np.uint8)
+    i420 = bgr_to_i420_host(bgr)
+    assert i420.shape == (48, 48)
+    back = np.asarray(i420_to_bgr(jnp.asarray(i420[None])))[0]
+    ref = cv2.cvtColor(i420, cv2.COLOR_YUV2BGR_I420).astype(np.float32)
+    # chroma subsampling loses information; both paths must agree closely
+    assert np.abs(back - ref).mean() < 3.0
+
+
+def test_preprocess_i420_wire():
+    from evam_tpu.ops.color import bgr_to_i420_host
+
+    bgr = np.full((16, 16, 3), 128, np.uint8)
+    i420 = bgr_to_i420_host(bgr)[None]
+    spec = PreprocessSpec(height=16, width=16, color_space="BGR", dtype="float32",
+                          wire_format="i420")
+    out = np.asarray(preprocess_batch(jnp.asarray(i420), spec))
+    assert out.shape == (1, 16, 16, 3)
+    assert abs(out.mean() - 128.0) < 2.0

@@ -223,3 +223,35 @@ def test_metaconvert_merges_messages():
     out = stage.process(ctx)[0]
     assert out.metadata["events"][0]["event-type"] == "zone-count"
     assert out.metadata["source"] == "s"
+
+
+def test_fused_detect_classify(loader, hub):
+    # classification pipeline must produce identical-schema output via
+    # the fused engine, with only ONE engine round-trip per frame
+    from evam_tpu.stages.infer import FusedDetectClassifyStage
+    from evam_tpu.graph import resolve_parameters
+    spec = loader.get("object_classification", "vehicle_attributes")
+    stages_spec, _ = resolve_parameters(
+        spec, {"detection-threshold": 0.0, "object-class": ""})
+    from evam_tpu.stages import build_stages
+    stages = build_stages(stages_spec, hub, source_uri="s")
+    fused = [s for s in stages if isinstance(s, FusedDetectClassifyStage)]
+    assert fused, "fusion pass must fire for detect→classify chains"
+
+    runner, outputs = _run_pipeline(
+        loader, hub, "object_classification", "vehicle_attributes",
+        {"detection-threshold": 0.0, "object-class": ""}, count=4,
+    )
+    attrs = [o for m in outputs for o in m["objects"] if "color" in o]
+    assert attrs
+
+
+def test_fusion_skipped_when_disabled(loader, hub):
+    from evam_tpu.stages.infer import DetectStage, ClassifyStage
+    from evam_tpu.graph import resolve_parameters
+    from evam_tpu.stages import build_stages
+    spec = loader.get("object_classification", "vehicle_attributes")
+    stages_spec, _ = resolve_parameters(spec, {})
+    stages = build_stages(stages_spec, hub, fuse=False)
+    kinds = [type(s).__name__ for s in stages]
+    assert "DetectStage" in kinds and "ClassifyStage" in kinds
