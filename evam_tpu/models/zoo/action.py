@@ -15,6 +15,8 @@ sequence sharding is needed at clip length 16 (SURVEY.md §5.7).
 
 from __future__ import annotations
 
+from typing import Callable
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -42,17 +44,32 @@ class ActionEncoder(nn.Module):
 
 
 class TransformerBlock(nn.Module):
+    """Pre-norm block. ``attention_fn`` swaps the attention kernel
+    (e.g. ring attention from evam_tpu.parallel.ring for
+    sequence-parallel training) without changing the param tree.
+    ``mlp_constraint`` applies a sharding constraint to the MLP hidden
+    activation (tensor parallelism)."""
+
     dim: int
     heads: int = 8
     mlp_ratio: int = 4
+    attention_fn: Callable | None = None
+    mlp_constraint: Callable | None = None
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm()(x)
-        h = nn.MultiHeadDotProductAttention(num_heads=self.heads)(h, h)
+        attn_kwargs = {}
+        if self.attention_fn is not None:
+            attn_kwargs["attention_fn"] = self.attention_fn
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, **attn_kwargs
+        )(h, h)
         x = x + h
         h = nn.LayerNorm()(x)
         h = nn.Dense(self.dim * self.mlp_ratio)(h)
+        if self.mlp_constraint is not None:
+            h = self.mlp_constraint(h)
         h = nn.gelu(h)
         h = nn.Dense(self.dim)(h)
         return x + h
@@ -66,6 +83,8 @@ class ActionDecoder(nn.Module):
     dim: int = 512
     depth: int = 4
     heads: int = 8
+    attention_fn: Callable | None = None
+    mlp_constraint: Callable | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -75,7 +94,12 @@ class ActionDecoder(nn.Module):
         )
         x = nn.Dense(self.dim)(x) + pos
         for _ in range(self.depth):
-            x = TransformerBlock(self.dim, self.heads)(x)
+            x = TransformerBlock(
+                self.dim,
+                self.heads,
+                attention_fn=self.attention_fn,
+                mlp_constraint=self.mlp_constraint,
+            )(x)
         x = nn.LayerNorm()(x)
         x = x.mean(axis=1)
         return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,169 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+The reference has no sequence parallelism (SURVEY.md §5.7) — its
+longest temporal extent is the 16-frame action-recognition clip. The
+TPU rebuild makes long-context first-class anyway: when clips (or any
+token sequence) outgrow one chip's HBM, the sequence axis shards over
+a ``seq`` mesh axis and attention runs as a ring — each device holds
+one K/V block, blocks rotate around the ring via `lax.ppermute` (one
+ICI hop per step) while every device accumulates its queries' output
+with an online-softmax (flash-attention style) running max/sum. Full
+attention in O(T/n) memory per device, with communication overlapped
+by the compiler across scan steps.
+
+Differentiable end-to-end (`ppermute` has a transpose rule), so the
+same kernel serves training (evam_tpu.parallel.train) and inference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _ring_attention_kernel(
+    q: jax.Array,  # [B, Tq, H, D] local shard
+    k: jax.Array,  # [B, Tk, H, D] local shard
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    scale: float,
+) -> jax.Array:
+    """Per-shard ring loop. Runs inside shard_map over ``axis_name``."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    my_idx = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * scale
+    # Accumulators in [B, H, Tq, ...] layout (scores are bhqk). Derived
+    # from qf (not fresh constants) so they carry the same varying
+    # manual axes as the scan outputs under shard_map's VMA typing.
+    qt = qf.transpose(0, 2, 1, 3) * 0.0
+    o = qt
+    m = qt[..., 0] + NEG_INF
+    l = qt[..., 0]
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # Block i currently holds the K/V shard originally owned by
+        # ring neighbor (my_idx - i) mod n.
+        owner = (my_idx - i) % axis_size
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = my_idx * tq + jnp.arange(tq)
+            k_pos = owner * tk + jnp.arange(tk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Tq, H, D]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    head_axis: str | None = "model",
+    causal: bool = False,
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh.shape[seq_axis]`` shards.
+
+    q/k/v: [B, T, H, D] global arrays (sharded or not — shard_map
+    repartitions). Batch rides ``batch_axis`` (pure data parallel),
+    heads ride ``head_axis`` (tensor parallel — heads are independent
+    in attention, so no extra collective), sequence rides the ring.
+    """
+    n = mesh.shape[seq_axis]
+    scale = q.shape[-1] ** -0.5
+    if n == 1 and mesh.shape.get(head_axis or "", 1) == 1:
+        return plain_attention(q, k, v, causal=causal, scale=scale)
+
+    spec = P(
+        batch_axis if batch_axis in mesh.axis_names else None,
+        seq_axis,
+        head_axis if head_axis in mesh.axis_names else None,
+        None,
+    )
+    kernel = functools.partial(
+        _ring_attention_kernel,
+        axis_name=seq_axis,
+        axis_size=n,
+        causal=causal,
+        scale=scale,
+    )
+    sharded = shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return sharded(q, k, v)
+
+
+def plain_attention(q, k, v, *, causal=False, scale=None):
+    """Single-device reference attention (same layout as ring)."""
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_flax_attention_fn(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    head_axis: str | None = "model",
+    causal: bool = False,
+) -> Callable:
+    """Adapter: ring_attention as a drop-in ``attention_fn`` for
+    `flax.linen.MultiHeadDotProductAttention` — the serving model's
+    param tree is unchanged, only the attention computation swaps, so
+    weights trained sequence-parallel load directly into the serving
+    ActionDecoder (evam_tpu.models.zoo.action)."""
+
+    def attention_fn(query, key, value, **kwargs):
+        return ring_attention(
+            query, key, value, mesh,
+            seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
+            causal=causal,
+        )
+
+    return attention_fn

@@ -1,0 +1,282 @@
+"""Distributed training step: dp x sp x tp over one `jax.sharding.Mesh`.
+
+The reference is inference-only (SURVEY.md §2d) — models arrive
+pre-trained from OMZ. A TPU-native framework owns the other half of
+the model lifecycle too: this module fine-tunes the action-recognition
+model (the largest zoo member, encoder + temporal transformer decoder)
+with every parallelism axis the hardware offers, so the same code
+scales from one chip to a multi-host pod:
+
+* **data parallel** (``data`` axis): the clip batch shards; XLA
+  inserts the gradient psum.
+* **sequence parallel** (``seq`` axis): the clip's temporal axis
+  shards; decoder attention runs as a ring (evam_tpu.parallel.ring,
+  `ppermute` over ICI). For the frame encoder the seq axis is just
+  more data parallelism — frames reshape to one (B*T) batch axis
+  sharded over data x seq.
+* **tensor parallel** (``model`` axis): attention heads and the MLP
+  hidden dimension shard Megatron-style via param shardings +
+  activation constraints; XLA inserts the all-reduces.
+
+Everything is one `jit` — no hand-scheduled collectives outside the
+ring kernel. The driver's `dryrun_multichip` entry point jits this
+step over an N-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from evam_tpu.models.zoo.action import ActionDecoder, ActionEncoder
+from evam_tpu.obs import get_logger
+from evam_tpu.parallel.ring import make_flax_attention_fn
+
+log = get_logger("parallel.train")
+
+
+def factor_mesh(n: int) -> tuple[int, int, int]:
+    """Split n devices into (data, seq, model) sizes.
+
+    Greedy powers-of-two: model and seq each take a factor of 2 when
+    available (tp wants the fewest devices — it all-reduces every
+    layer; sp rings once per attention; dp gets the rest, it
+    communicates only at the gradient psum)."""
+    tp = 2 if n % 2 == 0 and n >= 8 else 1
+    rem = n // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    return dp, sp, tp
+
+
+def build_train_mesh(devices=None, shape: tuple[int, int, int] | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, sp, tp = shape if shape is not None else factor_mesh(len(devices))
+    if dp * sp * tp != len(devices):
+        raise ValueError(f"mesh {dp}x{sp}x{tp} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, ("data", "seq", "model"))
+
+
+# --------------------------------------------------------- shardings
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def param_spec(path, leaf) -> P:
+    """Megatron-style placement for the decoder transformer; encoder
+    convs and small heads replicate."""
+    name = _path_str(path)
+    if "MultiHeadDotProductAttention" in name:
+        # qkv kernels [D, H, Dh]; out kernel [H, Dh, D]; biases follow.
+        if "/out/" in name:
+            return P("model") if leaf.ndim >= 2 else P()
+        if leaf.ndim == 3:
+            return P(None, "model", None)
+        if leaf.ndim == 2:
+            return P("model", None)
+        return P()
+    if "TransformerBlock" in name and "Dense_0" in name:
+        # MLP up-projection [D, 4D]: shard the hidden dim.
+        return P(None, "model") if leaf.ndim == 2 else P("model")
+    if "TransformerBlock" in name and "Dense_1" in name:
+        # MLP down-projection [4D, D]: shard the contracting dim.
+        return P("model", None) if leaf.ndim == 2 else P()
+    return P()
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_spec(path, leaf)), params
+    )
+
+
+# -------------------------------------------------------- train step
+
+@dataclasses.dataclass
+class ActionTrainConfig:
+    num_classes: int = 400
+    embed_dim: int = 512
+    depth: int = 4
+    heads: int = 8
+    encoder_width: int = 32
+    frame_size: tuple[int, int] = (224, 224)
+    clip_len: int = 16
+    learning_rate: float = 3e-4
+    weight_decay: float = 1e-4
+    remat_encoder: bool = True
+
+
+@dataclasses.dataclass
+class ActionTrainer:
+    """Owns models, optimizer, sharded state, and the jitted step."""
+
+    mesh: Mesh
+    config: ActionTrainConfig
+    encoder: ActionEncoder
+    decoder: ActionDecoder
+    tx: optax.GradientTransformation
+    train_step: Callable
+    state_shardings: Any
+
+    def init_state(self, seed: int = 0):
+        cfg = self.config
+        h, w = cfg.frame_size
+        k_enc, k_dec = jax.random.split(jax.random.PRNGKey(seed))
+        # Dummy batch must divide the mesh's data axis (the ring
+        # kernel shards even the init trace); params are batch-free.
+        b0 = self.mesh.shape["data"]
+        enc_params = self.encoder.init(
+            k_enc, jnp.zeros((1, h, w, 3), jnp.float32)
+        )["params"]
+        dec_params = self.decoder.init(
+            k_dec, jnp.zeros((b0, cfg.clip_len, cfg.embed_dim), jnp.float32)
+        )["params"]
+        params = {"enc": enc_params, "dec": dec_params}
+        opt_state = self.tx.init(params)
+        state = {"params": params, "opt_state": opt_state,
+                 "step": jnp.zeros((), jnp.int32)}
+        return jax.device_put(state, self.state_shardings)
+
+    def data_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("data", "seq"))
+
+    def shard_batch(self, clips: np.ndarray, labels: np.ndarray):
+        clip_sh = NamedSharding(self.mesh, P("data", "seq", None, None, None))
+        lbl_sh = NamedSharding(self.mesh, P("data"))
+        return jax.device_put(clips, clip_sh), jax.device_put(labels, lbl_sh)
+
+
+def build_action_trainer(
+    mesh: Mesh, config: ActionTrainConfig | None = None
+) -> ActionTrainer:
+    cfg = config or ActionTrainConfig()
+    mlp_constraint = functools.partial(
+        jax.lax.with_sharding_constraint,
+        shardings=NamedSharding(mesh, P("data", "seq", "model")),
+    )
+    attention_fn = make_flax_attention_fn(
+        mesh, seq_axis="seq", batch_axis="data", head_axis="model"
+    )
+    encoder = ActionEncoder(embed_dim=cfg.embed_dim, width=cfg.encoder_width)
+    decoder = ActionDecoder(
+        num_classes=cfg.num_classes,
+        dim=cfg.embed_dim,
+        depth=cfg.depth,
+        heads=cfg.heads,
+        attention_fn=attention_fn,
+        mlp_constraint=mlp_constraint,
+    )
+    tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+
+    enc_apply = encoder.apply
+    if cfg.remat_encoder:
+        # Trade encoder activations for recompute in backward — HBM is
+        # the binding constraint for video batches (B*T frames live).
+        enc_apply = jax.checkpoint(enc_apply)
+
+    frames_spec = NamedSharding(mesh, P(("data", "seq"), None, None, None))
+    emb_spec = NamedSharding(mesh, P("data", "seq", None))
+
+    def loss_fn(params, clips, labels):
+        b, t = clips.shape[:2]
+        x = clips.astype(jnp.float32) / 255.0
+        frames = x.reshape((b * t,) + x.shape[2:])
+        # Encoder: pure data parallelism over data x seq (frames are
+        # independent); bf16 activations keep the MXU fed.
+        frames = jax.lax.with_sharding_constraint(frames, frames_spec)
+        emb = enc_apply({"params": params["enc"]}, frames.astype(jnp.bfloat16))
+        emb = emb.reshape(b, t, -1).astype(jnp.float32)
+        # Decoder: sequence stays sharded; ring attention inside.
+        emb = jax.lax.with_sharding_constraint(emb, emb_spec)
+        logits = decoder.apply({"params": params["dec"]}, emb)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, acc
+
+    def step_fn(state, clips, labels):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], clips, labels
+        )
+        updates, opt_state = tx.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    # Sharding structure needs concrete params; init abstractly.
+    h, w = cfg.frame_size
+    b0 = mesh.shape["data"]
+    abstract = jax.eval_shape(
+        lambda k: {
+            "enc": encoder.init(k, jnp.zeros((1, h, w, 3), jnp.float32))["params"],
+            "dec": decoder.init(k, jnp.zeros((b0, cfg.clip_len, cfg.embed_dim),
+                                             jnp.float32))["params"],
+        },
+        jax.random.PRNGKey(0),
+    )
+    p_shardings = param_shardings(mesh, abstract)
+    # Adam moments mirror the param layout; other optax state replicates.
+    opt_state_struct = jax.eval_shape(tx.init, abstract)
+    opt_shardings = _shard_like_params(
+        opt_state_struct, abstract, p_shardings, mesh
+    )
+
+    state_shardings = {
+        "params": p_shardings,
+        "opt_state": opt_shardings,
+        "step": NamedSharding(mesh, P()),
+    }
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(
+            state_shardings,
+            NamedSharding(mesh, P("data", "seq", None, None, None)),
+            NamedSharding(mesh, P("data")),
+        ),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return ActionTrainer(
+        mesh=mesh,
+        config=cfg,
+        encoder=encoder,
+        decoder=decoder,
+        tx=tx,
+        train_step=train_step,
+        state_shardings=state_shardings,
+    )
+
+
+def _shard_like_params(opt_struct, param_struct, p_shardings, mesh):
+    """Adam m/v trees share the param tree structure — shard them the
+    same way; scalar/other leaves replicate."""
+    param_treedef = jax.tree_util.tree_structure(param_struct)
+
+    def place(node):
+        if jax.tree_util.tree_structure(node) == param_treedef:
+            return p_shardings
+        return jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), node,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+
+    return jax.tree_util.tree_map(
+        place, opt_struct,
+        is_leaf=lambda x: jax.tree_util.tree_structure(x) == param_treedef
+        or isinstance(x, jax.ShapeDtypeStruct),
+    )
