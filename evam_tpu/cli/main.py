@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from evam_tpu.config import get_settings
@@ -76,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     configure_logging()
+    # Fake-TPU backend (SURVEY.md §4): EVAM_PLATFORM=cpu runs the full
+    # serving path without TPU hardware (the image's .axon_site hook
+    # rewrites JAX_PLATFORMS at import, so a config update is needed).
+    platform = os.environ.get("EVAM_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -100,8 +100,12 @@ class EngineHub:
         **builder_kwargs,
     ) -> BatchEngine:
         """Fused detect+classify engine: one upload, one readback per
-        frame (see steps.build_detect_classify_step)."""
-        key = f"detect_classify:{instance_id or det_key + '+' + cls_key}"
+        frame (see steps.build_detect_classify_step). Builder kwargs
+        (e.g. the object-class filter) are part of the cache key —
+        pipelines may only share a fused program when the compiled
+        semantics match."""
+        kw_sig = ",".join(f"{k}={v}" for k, v in sorted(builder_kwargs.items()))
+        key = f"detect_classify:{instance_id or det_key + '+' + cls_key}:{kw_sig}"
         with self._lock:
             if key not in self._engines:
                 det = self.model(det_key)

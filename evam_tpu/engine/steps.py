@@ -23,8 +23,6 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-import dataclasses
-
 from evam_tpu.models.registry import LoadedModel
 from evam_tpu.ops.boxes import decode_boxes
 from evam_tpu.ops.nms import batched_nms
@@ -37,10 +35,6 @@ from evam_tpu.ops.preprocess import (
 
 #: Packed detection row layout: [x0, y0, x1, y1, score, label, valid]
 DETECT_FIELDS = 7
-
-
-def _wired(model: LoadedModel, wire_format: str):
-    return dataclasses.replace(model.preprocess, wire_format=wire_format)
 
 
 def _detect_packed(params, bgr, model, anchors, max_detections,
@@ -97,6 +91,7 @@ def build_detect_classify_step(
     iou_threshold: float = 0.45,
     score_threshold: float = 0.3,
     wire_format: str = "bgr",
+    allowed_label_ids: tuple[int, ...] | None = None,
 ) -> Callable:
     """Fused gvadetect+gvaclassify: ONE frame upload, ONE readback.
 
@@ -105,9 +100,14 @@ def build_detect_classify_step(
     (pipelines/object_classification/vehicle_attributes/
     pipeline.json:4-5); fusing them into one XLA program keeps the
     decoded frame in HBM: preprocess → SSD → NMS → on-device ROI crop
-    of the top-R boxes → classifier — one jit. Output
-    [B, K, 7 + total_classes]: packed detections, with per-head
-    probability vectors for the first ``roi_budget`` rows.
+    of the top-R eligible boxes → classifier — one jit.
+    ``allowed_label_ids`` is the object-class filter applied BEFORE
+    ROI selection (gvaclassify filters by class first, then
+    classifies — stages/infer.py _eligible), so budget slots are
+    never wasted on filtered-out classes. Output
+    [B, K, 7 + total_classes]: packed detections; a row's probability
+    block is all-zero iff that detection was not classified
+    (softmaxed blocks sum to #heads otherwise).
     """
     anchors = jnp.asarray(det_model.anchors)
     head_total = sum(n for _, n in cls_model.spec.heads)
@@ -120,7 +120,21 @@ def build_detect_classify_step(
             iou_threshold, score_threshold,
         )
         b = bgr.shape[0]
-        roi_boxes = bx[:, :roi_budget, :]  # NMS output is score-sorted
+        eligible = packed[..., 6] > 0.5
+        if allowed_label_ids is not None:
+            labels = packed[..., 5]
+            ok = jnp.zeros_like(eligible)
+            for lid in allowed_label_ids:
+                ok = ok | (labels == float(lid))
+            eligible = eligible & ok
+        # Stable sort: eligible rows first, NMS score order preserved
+        # within each group.
+        order = jnp.argsort(
+            (~eligible).astype(jnp.int32), axis=1, stable=True
+        )
+        roi_idx = order[:, :roi_budget]
+        roi_boxes = jnp.take_along_axis(bx, roi_idx[..., None], axis=1)
+        roi_ok = jnp.take_along_axis(eligible, roi_idx, axis=1)
         crops = crop_rois(bgr, roi_boxes, (cls_pre.height, cls_pre.width))
         crops = crops.reshape((b * roi_budget,) + crops.shape[2:])
         cls_in = preprocess_bgr(crops, cls_pre)
@@ -132,8 +146,11 @@ def build_detect_classify_step(
             ],
             axis=-1,
         ).reshape(b, roi_budget, head_total)
-        pad = jnp.zeros((b, packed.shape[1] - roi_budget, head_total), jnp.float32)
-        return jnp.concatenate([packed, jnp.concatenate([probs, pad], axis=1)], axis=-1)
+        probs = probs * roi_ok[..., None]
+        # Scatter each ROI's probs back onto its detection row.
+        full = jnp.zeros((b, packed.shape[1], head_total), jnp.float32)
+        full = full.at[jnp.arange(b)[:, None], roi_idx].set(probs)
+        return jnp.concatenate([packed, full], axis=-1)
 
     return step
 

@@ -48,6 +48,12 @@ def bgr_to_i420_host(frame: np.ndarray) -> np.ndarray:
 
 
 def i420_shape(height: int, width: int) -> tuple[int, int]:
-    if height % 2 or width % 2:
-        raise ValueError("I420 needs even dimensions")
+    # The planar wire layout packs the h/2 x w/2 U and V planes as
+    # h/4 full-width rows each, so height must divide by 4 (i420_to_bgr
+    # reshapes on that assumption); width by 2.
+    if height % 4 or width % 2:
+        raise ValueError(
+            f"I420 wire layout needs height%4==0 and width%2==0, got "
+            f"{height}x{width}"
+        )
     return (height * 3 // 2, width)

@@ -1,0 +1,20 @@
+"""Result destinations — the gvametapublish counterpart (reference
+pipelines/*/pipeline.json templates end in gvametapublish; destination
+types mqtt/file observed at charts/templates/NOTES.txt:15-19 and the
+request schema ``destination.metadata.{type,host,topic}``)."""
+
+from evam_tpu.publish.base import Destination, create_destination
+from evam_tpu.publish.encode import encode_frame
+from evam_tpu.publish.file_dest import FileDestination, StdoutDestination
+from evam_tpu.publish.mqtt import MqttDestination
+from evam_tpu.publish.zmq_dest import ZmqDestination
+
+__all__ = [
+    "Destination",
+    "FileDestination",
+    "MqttDestination",
+    "StdoutDestination",
+    "ZmqDestination",
+    "create_destination",
+    "encode_frame",
+]

@@ -1,0 +1,44 @@
+"""Frame encoding for publication.
+
+The reference publisher optionally JPEG/PNG-encodes frames before the
+message bus (cv2.imencode at evas/publisher.py:127-151, gated by
+``encoding.type``/``encoding.level``); same semantics here, on host
+CPU — encode is per-stream and embarrassingly parallel, the TPU stays
+on inference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def encode_frame(
+    frame_bgr: np.ndarray,
+    enc_type: str | None,
+    level: int | None = None,
+) -> bytes:
+    """Encode BGR uint8 → bytes. enc_type: None/raw, jpeg, png.
+
+    level: jpeg quality 0-100 (default 95) or png compression 0-9
+    (default 3), mirroring the reference's validation ranges
+    (evas/publisher.py:105-125).
+    """
+    if not enc_type or enc_type == "raw":
+        return np.ascontiguousarray(frame_bgr).tobytes()
+    import cv2
+
+    if enc_type == "jpeg":
+        q = 95 if level is None else int(level)
+        if not 0 <= q <= 100:
+            raise ValueError(f"jpeg quality {q} outside [0, 100]")
+        ok, buf = cv2.imencode(".jpg", frame_bgr, [cv2.IMWRITE_JPEG_QUALITY, q])
+    elif enc_type == "png":
+        c = 3 if level is None else int(level)
+        if not 0 <= c <= 9:
+            raise ValueError(f"png compression {c} outside [0, 9]")
+        ok, buf = cv2.imencode(".png", frame_bgr, [cv2.IMWRITE_PNG_COMPRESSION, c])
+    else:
+        raise ValueError(f"unsupported encoding type '{enc_type}'")
+    if not ok:
+        raise RuntimeError(f"{enc_type} encode failed")
+    return bytes(buf.tobytes())

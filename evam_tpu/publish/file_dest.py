@@ -1,0 +1,53 @@
+"""File / stdout metadata destinations (gvametapublish method=file
+counterpart — the reference's default file format is one JSON object
+per line)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+
+class FileDestination:
+    """JSON-lines (default) or JSON-array metadata file."""
+
+    def __init__(self, path: str, fmt: str = "json-lines"):
+        self.path = path
+        self.fmt = fmt
+        self._lock = threading.Lock()
+        self._fh = open(path, "w", encoding="utf-8")
+        self._first = True
+        if fmt == "json":
+            self._fh.write("[")
+
+    def publish(self, meta: dict, frame: bytes | None = None) -> None:
+        line = json.dumps(meta, separators=(",", ":"))
+        with self._lock:
+            if self.fmt == "json":
+                if not self._first:
+                    self._fh.write(",\n")
+                self._first = False
+                self._fh.write(line)
+            else:
+                self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self.fmt == "json":
+                self._fh.write("]\n")
+            self._fh.close()
+
+
+class StdoutDestination:
+    """Print metadata lines (sample-verification flow: the reference
+    docs verify pipelines by eyeballing published JSON,
+    charts/README.md:112-119)."""
+
+    def publish(self, meta: dict, frame: bytes | None = None) -> None:
+        sys.stdout.write(json.dumps(meta, separators=(",", ":")) + "\n")
+        sys.stdout.flush()
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,56 @@
+"""ZeroMQ PUB destination with the EII MsgBus wire contract.
+
+The reference's EII data plane is brokerless ZeroMQ pub/sub carrying
+``(json-meta, frame-blob)`` message pairs (evas/publisher.py:246-250;
+transports zmq_tcp / zmq_ipc at eii/config.json:17-19, 31-32). The
+frame convention: multipart [topic, meta-json, blob?] so subscribers
+filter server-side by topic prefix.
+"""
+
+from __future__ import annotations
+
+import json
+
+from evam_tpu.obs import get_logger
+
+log = get_logger("publish.zmq")
+
+
+class ZmqDestination:
+    def __init__(
+        self,
+        endpoint: str = "tcp://127.0.0.1:65114",
+        topic: str = "evam_tpu",
+        bind: bool = True,
+        send_hwm: int = 1000,
+    ):
+        import zmq
+
+        self.topic = topic.encode()
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        # HWM gives the same backpressure knob as the reference's
+        # zmq_recv_hwm (eii/config.json:37): overflow drops, the
+        # engine never blocks on a slow consumer.
+        self._sock.setsockopt(zmq.SNDHWM, send_hwm)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if bind:
+            self._sock.bind(endpoint)
+        else:
+            self._sock.connect(endpoint)
+        log.info("zmq pub %s endpoint %s", "bound" if bind else "connected",
+                 endpoint)
+
+    def publish(self, meta: dict, frame: bytes | None = None) -> None:
+        parts = [self.topic, json.dumps(meta, separators=(",", ":")).encode()]
+        if frame is not None:
+            parts.append(frame)
+        import zmq
+
+        try:
+            self._sock.send_multipart(parts, flags=zmq.NOBLOCK)
+        except zmq.Again:
+            pass  # HWM reached: drop (slow-consumer backpressure)
+
+    def close(self) -> None:
+        self._sock.close(0)

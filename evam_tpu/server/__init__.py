@@ -1,0 +1,8 @@
+"""REST serving layer — the PipelineServer/REST counterpart (reference
+base-image ``python3 -m server`` behind run.sh:29; API surface at
+charts/templates/NOTES.txt:7-21)."""
+
+from evam_tpu.server.instance import InstanceState, StreamInstance
+from evam_tpu.server.registry import PipelineRegistry
+
+__all__ = ["InstanceState", "PipelineRegistry", "StreamInstance"]

@@ -1,0 +1,162 @@
+"""REST API — route-for-route counterpart of the reference's
+pipeline-server HTTP surface on :8080 (charts/templates/NOTES.txt:7-21,
+port at docker-compose.yml:44):
+
+    GET    /pipelines
+    GET    /pipelines/status
+    GET    /pipelines/{name}/{version}
+    POST   /pipelines/{name}/{version}        → instance id
+    GET    /pipelines/{name}/{version}/{id}
+    GET    /pipelines/{name}/{version}/{id}/status
+    DELETE /pipelines/{name}/{version}/{id}
+    GET    /models
+
+plus TPU-native additions: /metrics (Prometheus), /healthz, /engines
+(batch-occupancy introspection of the shared engines).
+
+aiohttp (in-image) instead of the reference's tornado-based server; the
+event loop only routes control traffic — frames never touch it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from aiohttp import web
+
+from evam_tpu.config import Settings
+from evam_tpu.obs import get_logger, metrics
+from evam_tpu.server.registry import PipelineRegistry, RequestError
+
+log = get_logger("server.app")
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def build_app(
+    registry: PipelineRegistry, stop_registry_on_shutdown: bool = False
+) -> web.Application:
+    """``stop_registry_on_shutdown`` makes the app own the registry's
+    lifecycle (run_server does); embedders/tests that share a registry
+    across apps keep the default False."""
+    app = web.Application()
+    app["registry"] = registry
+
+    async def list_pipelines(request: web.Request) -> web.Response:
+        return web.json_response(registry.pipelines())
+
+    async def all_statuses(request: web.Request) -> web.Response:
+        return web.json_response(registry.statuses())
+
+    async def describe(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        version = request.match_info["version"]
+        desc = registry.describe(name, version)
+        if desc is None:
+            return _json_error(404, f"pipeline {name}/{version} not found")
+        return web.json_response(desc)
+
+    async def start(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        version = request.match_info["version"]
+        try:
+            body: dict[str, Any] = await request.json()
+        except json.JSONDecodeError:
+            return _json_error(400, "request body must be JSON")
+        try:
+            instance = await asyncio.to_thread(
+                registry.start_instance, name, version, body
+            )
+        except KeyError as exc:
+            return _json_error(404, str(exc.args[0]))
+        except (RequestError, ValueError) as exc:
+            return _json_error(400, str(exc))
+        # The reference returns the bare instance id
+        # (charts/README.md:92 "instance = <uuid>").
+        return web.json_response(instance.id)
+
+    def _find(request: web.Request):
+        inst = registry.get_instance(request.match_info["instance_id"])
+        if inst is None:
+            return None
+        if (inst.pipeline_name != request.match_info["name"]
+                or inst.version != request.match_info["version"]):
+            return None
+        return inst
+
+    async def instance_summary(request: web.Request) -> web.Response:
+        inst = _find(request)
+        if inst is None:
+            return _json_error(404, "instance not found")
+        return web.json_response(inst.summary())
+
+    async def instance_status(request: web.Request) -> web.Response:
+        inst = _find(request)
+        if inst is None:
+            return _json_error(404, "instance not found")
+        return web.json_response(inst.status())
+
+    async def instance_stop(request: web.Request) -> web.Response:
+        inst = _find(request)
+        if inst is None:
+            return _json_error(404, "instance not found")
+        await asyncio.to_thread(registry.stop_instance, inst.id)
+        return web.json_response(inst.status())
+
+    async def list_models(request: web.Request) -> web.Response:
+        return web.json_response(registry.hub.registry.keys())
+
+    async def engines(request: web.Request) -> web.Response:
+        return web.json_response(registry.hub.stats())
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain")
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.add_routes([
+        web.get("/pipelines", list_pipelines),
+        web.get("/pipelines/status", all_statuses),
+        web.get("/pipelines/{name}/{version}", describe),
+        web.post("/pipelines/{name}/{version}", start),
+        web.get("/pipelines/{name}/{version}/{instance_id}", instance_summary),
+        web.get("/pipelines/{name}/{version}/{instance_id}/status",
+                instance_status),
+        web.delete("/pipelines/{name}/{version}/{instance_id}", instance_stop),
+        web.get("/models", list_models),
+        web.get("/engines", engines),
+        web.get("/metrics", metrics_endpoint),
+        web.get("/healthz", healthz),
+    ])
+
+    if stop_registry_on_shutdown:
+        async def on_shutdown(app: web.Application) -> None:
+            await asyncio.to_thread(registry.stop_all)
+
+        app.on_shutdown.append(on_shutdown)
+    return app
+
+
+def run_server(settings: Settings) -> int:
+    """Blocking entrypoint for ``evam-tpu serve --mode EVA``."""
+    registry = PipelineRegistry(settings)
+    registry.resume()
+    app = build_app(registry, stop_registry_on_shutdown=True)
+    extras = []
+    if settings.enable_rtsp:
+        from evam_tpu.publish.rtsp import RtspServer
+
+        rtsp = RtspServer(port=settings.rtsp_port)
+        rtsp.start()
+        app["rtsp"] = rtsp
+        extras.append(f"rtsp://0.0.0.0:{settings.rtsp_port}")
+    log.info("REST serving on :%d %s", settings.rest_port,
+             f"(+ {', '.join(extras)})" if extras else "")
+    web.run_app(app, port=settings.rest_port, print=None)
+    return 0

@@ -1,0 +1,208 @@
+"""StreamInstance: one running pipeline instance.
+
+TPU restatement of the reference's per-instance lifecycle
+(`pipeline.start(source, destination, parameters)` → instance with
+status/stop — evas/manager.py:134-146 and the REST contract
+charts/templates/NOTES.txt:7-21). The instance owns only light host
+work: a decode thread walking the stage chain via StreamRunner; all
+inference rides the shared EngineHub batch queues. A dying stream
+never takes the engine down (per-stream supervision, SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+import uuid
+from typing import Any, Callable
+
+from evam_tpu.media.source import create_source
+from evam_tpu.obs import get_logger, metrics
+from evam_tpu.publish.base import Destination, NullDestination
+from evam_tpu.stages.base import Stage
+from evam_tpu.stages.context import FrameContext
+from evam_tpu.stages.runner import StreamRunner
+
+log = get_logger("server.instance")
+
+
+class InstanceState(str, enum.Enum):
+    """Reference pipeline-server states (observed in its REST status
+    payloads: QUEUED → RUNNING → COMPLETED | ERROR | ABORTED)."""
+
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    ERROR = "ERROR"
+    ABORTED = "ABORTED"
+
+
+class StreamInstance:
+    def __init__(
+        self,
+        pipeline_name: str,
+        version: str,
+        stages: list[Stage],
+        request: dict[str, Any],
+        destination: Destination | None = None,
+        frame_sink: Callable[[FrameContext], None] | None = None,
+        max_retries: int = 3,
+        retry_backoff_s: float = 1.0,
+        on_finish: Callable[["StreamInstance"], None] | None = None,
+    ):
+        self.id = str(uuid.uuid4())
+        self.pipeline_name = pipeline_name
+        self.version = version
+        self.request = request
+        self.stages = stages
+        self.destination = destination or NullDestination()
+        self.frame_sink = frame_sink
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.on_finish = on_finish
+
+        self.state = InstanceState.QUEUED
+        self.error: str | None = None
+        self.start_time: float | None = None
+        self.end_time: float | None = None
+        self._source = None
+        self._runner: StreamRunner | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # Guards _source against the stop()-vs-retry-reassignment race.
+        self._src_lock = threading.Lock()
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"stream-{self.id[:8]}", daemon=True
+        )
+        self.start_time = time.time()
+        self.state = InstanceState.RUNNING
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._runner is not None:
+            self._runner.stop()
+        with self._src_lock:
+            if self._source is not None:
+                self._source.close()
+
+    def wait(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------- internals
+
+    def _run(self) -> None:
+        attempts = 0
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._run_once()
+                    # A stop() mid-stream drains early: that is an
+                    # abort, not a natural completion.
+                    self.state = (
+                        InstanceState.ABORTED
+                        if self._stop.is_set()
+                        else InstanceState.COMPLETED
+                    )
+                    break
+                except Exception as exc:  # noqa: BLE001 — supervision boundary
+                    attempts += 1
+                    if self._stop.is_set() or attempts > self.max_retries:
+                        raise
+                    # Source reconnect with backoff (reference leaves
+                    # this as a TODO, evas/publisher.py:253-255).
+                    delay = self.retry_backoff_s * (2 ** (attempts - 1))
+                    log.warning(
+                        "stream %s attempt %d failed (%s); retrying in %.1fs",
+                        self.id[:8], attempts, exc, delay,
+                    )
+                    if self._stop.wait(delay):
+                        break
+            if self._stop.is_set() and self.state == InstanceState.RUNNING:
+                self.state = InstanceState.ABORTED
+        except Exception as exc:  # noqa: BLE001
+            self.state = InstanceState.ERROR
+            self.error = f"{type(exc).__name__}: {exc}"
+            log.error("stream %s failed permanently: %s", self.id[:8], self.error)
+            metrics.inc("evam_stream_failures")
+        finally:
+            self.end_time = time.time()
+            try:
+                self.destination.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if self.on_finish is not None:
+                try:
+                    self.on_finish(self)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _run_once(self) -> None:
+        source = create_source(
+            self.request.get("source", {}),
+            realtime=bool(self.request.get("source", {}).get("realtime", False)),
+        )
+        with self._src_lock:
+            if self._stop.is_set():
+                source.close()
+                return
+            self._source = source
+        self._runner = StreamRunner(
+            stream_id=self.id,
+            stages=self.stages,
+            source_uri=self.request.get("source", {}).get("uri", ""),
+        )
+        try:
+            self._runner.run(source.frames())
+        finally:
+            # Each attempt owns its source: close it here so retries
+            # never leak capture handles (RTSP cameras commonly allow
+            # a single connection).
+            with self._src_lock:
+                source.close()
+                if self._source is source:
+                    self._source = None
+
+    # --------------------------------------------------------- status
+
+    @property
+    def avg_fps(self) -> float:
+        if self._runner is None or self.start_time is None:
+            return 0.0
+        end = self.end_time or time.time()
+        dt = max(end - self.start_time, 1e-9)
+        return self._runner.frames_out / dt
+
+    def status(self) -> dict[str, Any]:
+        """Reference status payload shape: id, state, avg_fps,
+        start_time, elapsed_time (+ error message when failed)."""
+        elapsed = 0.0
+        if self.start_time is not None:
+            elapsed = (self.end_time or time.time()) - self.start_time
+        out: dict[str, Any] = {
+            "id": self.id,
+            "state": self.state.value,
+            "avg_fps": round(self.avg_fps, 2),
+            "start_time": self.start_time,
+            "elapsed_time": round(elapsed, 3),
+        }
+        if self.error:
+            out["message"] = self.error
+        return out
+
+    def summary(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "request": {
+                "pipeline": {"name": self.pipeline_name,
+                             "version": self.version},
+                **self.request,
+            },
+            **self.status(),
+        }

@@ -1,0 +1,190 @@
+"""PipelineRegistry: definitions + shared engines + instance table.
+
+The reference's PipelineServer scans a pipelines dir and hands out
+per-instance handles (`PipelineServer.pipeline(name, version)` then
+`pipeline.start(...)`, evas/manager.py:134-141). Here the registry
+also owns the one EngineHub — the central inversion: instances are
+lightweight adapters around shared per-model batch engines
+(SURVEY.md §7 architecture stance).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Any
+
+from evam_tpu.config import Settings
+from evam_tpu.engine.hub import EngineHub
+from evam_tpu.graph import PipelineLoader, resolve_parameters
+from evam_tpu.models.registry import ModelRegistry
+from evam_tpu.obs import get_logger
+from evam_tpu.parallel.mesh import build_mesh
+from evam_tpu.publish.base import create_destination
+from evam_tpu.server.instance import InstanceState, StreamInstance
+from evam_tpu.stages.build import build_stages
+
+log = get_logger("server.registry")
+
+
+class RequestError(ValueError):
+    """400-class problem with a start request."""
+
+
+class PipelineRegistry:
+    def __init__(self, settings: Settings, hub: EngineHub | None = None):
+        self.settings = settings
+        self.loader = PipelineLoader(settings.pipelines_dir)
+        if hub is None:
+            plan = build_mesh(
+                shape=list(settings.tpu.mesh_shape),
+                axes=list(settings.tpu.mesh_axes),
+            )
+            registry = ModelRegistry(
+                models_dir=settings.models_dir,
+                dtype=settings.tpu.precision,
+            )
+            hub = EngineHub(
+                registry,
+                plan=plan,
+                max_batch=settings.tpu.max_batch,
+                deadline_ms=settings.tpu.batch_deadline_ms,
+            )
+        self.hub = hub
+        self.instances: dict[str, StreamInstance] = {}
+        self._lock = threading.Lock()
+        self._draining = False
+        self._state_file = (
+            Path(settings.state_dir) / "streams.json"
+            if settings.state_dir else None
+        )
+
+    # ----------------------------------------------------- definitions
+
+    def pipelines(self) -> list[dict[str, Any]]:
+        out = []
+        for name, version in self.loader.names():
+            spec = self.loader.get(name, version)
+            out.append({
+                "name": name,
+                "version": version,
+                "type": spec.raw.get("type", "evam_tpu"),
+                "description": spec.description,
+                "parameters": spec.parameters,
+            })
+        return out
+
+    def describe(self, name: str, version: str) -> dict[str, Any] | None:
+        spec = self.loader.get(name, version)
+        if spec is None:
+            return None
+        return {
+            "name": name,
+            "version": version,
+            "type": spec.raw.get("type", "evam_tpu"),
+            "description": spec.description,
+            "parameters": spec.parameters,
+        }
+
+    # -------------------------------------------------------- instances
+
+    def start_instance(
+        self, name: str, version: str, request: dict[str, Any]
+    ) -> StreamInstance:
+        spec = self.loader.get(name, version)
+        if spec is None:
+            raise KeyError(f"pipeline {name}/{version} not found")
+        if "source" not in request or "uri" not in request.get("source", {}) \
+                and request.get("source", {}).get("type", "uri") == "uri":
+            raise RequestError("request.source.uri is required")
+        params = request.get("parameters") or {}
+        stage_specs, _ = resolve_parameters(spec, params)
+        dest_cfg = (request.get("destination") or {}).get("metadata")
+        destination = create_destination(dest_cfg)
+        instance = StreamInstance(
+            pipeline_name=name,
+            version=version,
+            stages=[],
+            request=request,
+            destination=destination,
+            on_finish=lambda _inst: self._persist(),
+        )
+        stages = build_stages(
+            stage_specs,
+            self.hub,
+            source_uri=request.get("source", {}).get("uri", ""),
+            publish_fn=lambda ctx: destination.publish(ctx.metadata),
+        )
+        instance.stages = stages
+        with self._lock:
+            self.instances[instance.id] = instance
+        instance.start()
+        log.info("started %s/%s instance %s", name, version, instance.id)
+        self._persist()
+        return instance
+
+    def get_instance(self, instance_id: str) -> StreamInstance | None:
+        return self.instances.get(instance_id)
+
+    def stop_instance(self, instance_id: str) -> StreamInstance | None:
+        inst = self.instances.get(instance_id)
+        if inst is not None:
+            inst.stop()
+            self._persist()
+        return inst
+
+    def statuses(self) -> list[dict[str, Any]]:
+        return [i.status() for i in self.instances.values()]
+
+    def stop_all(self) -> None:
+        # Shutdown drain must keep streams.json intact: these streams
+        # should re-attach on the next boot (unlike per-stream DELETE).
+        self._draining = True
+        for inst in list(self.instances.values()):
+            inst.stop()
+        for inst in list(self.instances.values()):
+            inst.wait(timeout=5)
+        self.hub.stop()
+
+    # ------------------------------------------------- restart/resume
+
+    def _persist(self) -> None:
+        """Persist active stream requests so a restarted server can
+        re-attach them (SURVEY.md §5.4 — the reference is stateless
+        and drops streams on restart; k8s Recreate just restarts the
+        container)."""
+        if self._state_file is None or self._draining:
+            return
+        active = [
+            {
+                "pipeline": i.pipeline_name,
+                "version": i.version,
+                "request": i.request,
+            }
+            for i in self.instances.values()
+            if i.state in (InstanceState.QUEUED, InstanceState.RUNNING)
+            # _stop records intent immediately; the worker thread flips
+            # state to ABORTED asynchronously, so state alone would
+            # resurrect deliberately-stopped streams on restart.
+            and not i._stop.is_set()
+        ]
+        self._state_file.parent.mkdir(parents=True, exist_ok=True)
+        self._state_file.write_text(json.dumps(active, indent=2))
+
+    def resume(self) -> int:
+        """Re-start streams recorded by a previous run. Returns count."""
+        if self._state_file is None or not self._state_file.exists():
+            return 0
+        entries = json.loads(self._state_file.read_text())
+        n = 0
+        for e in entries:
+            try:
+                self.start_instance(e["pipeline"], e["version"], e["request"])
+                n += 1
+            except Exception as exc:  # noqa: BLE001
+                log.warning("resume of %s/%s failed: %s",
+                            e.get("pipeline"), e.get("version"), exc)
+        if n:
+            log.info("resumed %d stream(s) from %s", n, self._state_file)
+        return n

@@ -30,13 +30,20 @@ from evam_tpu.stages.udf import UdfStage
 def _fusable(specs: list[StageSpec]) -> tuple[int, int] | None:
     """Find (detect_idx, classify_idx) fusable into one engine pass:
     a detect stage whose following stages up to a classify are only
-    track/convert (order-insensitive host stages)."""
+    track/convert (order-insensitive host stages). A classify with
+    reclassify-interval > 1 is not fusable — that schedule (reuse
+    cached attributes between reclassifications, reference
+    object_classification/vehicle_attributes/pipeline.json:68-71)
+    is host state the single fused program can't express."""
     for i, spec in enumerate(specs):
         if spec.kind != StageKind.DETECT:
             continue
         for j in range(i + 1, len(specs)):
             kind = specs[j].kind
             if kind == StageKind.CLASSIFY:
+                props = specs[j].properties or {}
+                if int(props.get("reclassify-interval", 1) or 1) > 1:
+                    return None
                 return (i, j)
             if kind not in (StageKind.TRACK, StageKind.CONVERT):
                 break
@@ -53,6 +60,7 @@ def build_stages(
 ) -> list[Stage]:
     specs = list(specs)
     fused: FusedDetectClassifyStage | None = None
+    fused_det_idx = -1
     if fuse:
         pair = _fusable(specs)
         if pair is not None:
@@ -63,15 +71,17 @@ def build_stages(
                 det.model, cls.model,
                 det.properties, cls.properties, hub,
             )
+            # ci > di, so dropping the classify spec leaves di valid.
             specs = [s for k, s in enumerate(specs) if k != ci]
+            fused_det_idx = di
 
     stages: list[Stage] = []
-    for spec in specs:
+    for idx, spec in enumerate(specs):
         kind = spec.kind
         if kind in (StageKind.SOURCE, StageKind.DECODE):
             continue  # handled by the StreamInstance's DecodeWorker
         if kind == StageKind.DETECT:
-            if fused is not None:
+            if fused is not None and idx == fused_det_idx:
                 stages.append(fused)
             else:
                 stages.append(

@@ -32,6 +32,14 @@ log = get_logger("stages.infer")
 ENGINE_SCORE_FLOOR = 0.1
 
 
+def _wire_safe_size(size: tuple[int, int]) -> tuple[int, int]:
+    """Round an ingest (H, W) up to the I420 wire constraint
+    (ops.color.i420_shape: height%4, width%2) so user-set sizes like
+    430x768 can't break the planar encoding."""
+    h, w = int(size[0]), int(size[1])
+    return (-(-h // 4) * 4, -(-w // 2) * 2)
+
+
 def _resize_for_engine(frame: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     """Host-side resize to the engine's canonical ingest resolution so
     frames from heterogeneous streams stack into one batch."""
@@ -76,7 +84,9 @@ class DetectStage(AsyncStage):
         )
         self.model = hub.model(model_key)
         self.wire = hub.wire_format
-        self.ingest_size = (self.model.preprocess.height, self.model.preprocess.width)
+        self.ingest_size = _wire_safe_size(
+            (self.model.preprocess.height, self.model.preprocess.width)
+        )
         self._count = 0
         self._last_regions: list[Region] = []
 
@@ -144,7 +154,9 @@ class ClassifyStage(AsyncStage):
         # Crops are taken on-device from the submitted frame; a fixed
         # canonical ingest resolution keeps cross-stream batches
         # stackable while preserving enough pixels for small ROIs.
-        self.ingest_size = tuple(properties.get("ingest-size", (432, 768)))
+        self.ingest_size = _wire_safe_size(
+            tuple(properties.get("ingest-size", (432, 768)))
+        )
         self._count = 0
 
     def _eligible(self, ctx: FrameContext) -> list[Region]:
@@ -209,10 +221,10 @@ class ActionStage(AsyncStage):
         self.dec_engine = hub.engine("action_decode", dec_key)
         self.dec_model = hub.model(dec_key)
         self.enc_model = hub.model(enc_key)
-        self.ingest_size = (
+        self.ingest_size = _wire_safe_size((
             self.enc_model.preprocess.height,
             self.enc_model.preprocess.width,
-        )
+        ))
         self.clip: deque[np.ndarray] = deque(maxlen=CLIP_LEN)
         self.threshold = float(properties.get("threshold", 0.0))
         self.wire = hub.wire_format
@@ -300,10 +312,14 @@ class FusedDetectClassifyStage(AsyncStage):
     follows detect in the chain (the standard object_classification /
     object_tracking templates): one frame upload and one packed
     readback replace two of each, doubling effective ingest bandwidth
-    — the scarce resource on the host→TPU path. Classification probs
-    arrive for the top-R detections regardless of class; the
-    ``object-class`` filter decides host-side which regions get
-    attributes (matching gvaclassify's filter semantics)."""
+    — the scarce resource on the host→TPU path. The ``object-class``
+    filter runs inside the program (scores of non-matching classes are
+    ineligible for the ROI budget); a row whose probability block is
+    all-zero was not classified. Known trade-off vs the unfused pair:
+    ROI crops come from the frame pre-resized to the detector's input
+    (the 8x upload saving at 1080p), not a classification-sized
+    ingest; reclassify-interval > 1 disables fusion entirely
+    (stages/build.py _fusable)."""
 
     ROI_BUDGET = 8
 
@@ -321,19 +337,25 @@ class FusedDetectClassifyStage(AsyncStage):
         self.cls_threshold = float(cls_props.get("threshold", 0.0))
         self.object_class = cls_props.get("object-class")
         self.interval = max(1, int(det_props.get("inference-interval", 1)))
+        self.det_model = hub.model(det_key)
+        allowed = None
+        if self.object_class:
+            allowed = tuple(
+                i for i, lbl in enumerate(self.det_model.labels)
+                if lbl == self.object_class
+            )
         self.engine = hub.fused_engine(
             det_key,
             cls_key,
             det_props.get("model-instance-id"),
             roi_budget=self.ROI_BUDGET,
             score_threshold=ENGINE_SCORE_FLOOR,
+            allowed_label_ids=allowed,
         )
-        self.det_model = hub.model(det_key)
         self.cls_model = hub.model(cls_key)
         self.wire = hub.wire_format
-        self.ingest_size = (
-            self.det_model.preprocess.height,
-            self.det_model.preprocess.width,
+        self.ingest_size = _wire_safe_size(
+            (self.det_model.preprocess.height, self.det_model.preprocess.width)
         )
         self._count = 0
         self._last_regions: list[Region] = []
@@ -370,7 +392,9 @@ class FusedDetectClassifyStage(AsyncStage):
                 Tensor(name="detection", confidence=float(score),
                        label_id=lid, label=label, is_detection=True)
             )
-            if i < self.ROI_BUDGET and self.object_class in (None, "", label):
+            # An all-zero probability block marks an unclassified row
+            # (classified blocks are softmaxes summing to #heads).
+            if row[7:].sum() > 0.5:
                 for head_name, a, b in head_slices:
                     probs = row[a:b]
                     hid = int(np.argmax(probs))

@@ -1,0 +1,176 @@
+"""Destination-layer tests: wire-level MQTT against an in-test broker,
+ZMQ (json, blob) framing, file/stdout formats, frame encoding."""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from evam_tpu.publish import (
+    FileDestination,
+    MqttDestination,
+    ZmqDestination,
+    create_destination,
+    encode_frame,
+)
+from evam_tpu.publish.base import NullDestination
+
+
+class FakeBroker:
+    """Accepts one MQTT client; records PUBLISH (topic, payload)."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.published = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _read_packet(self, conn):
+        head = conn.recv(1)
+        if not head:
+            return None
+        length, shift = 0, 0
+        while True:
+            b = conn.recv(1)
+            length |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+        body = b""
+        while len(body) < length:
+            chunk = conn.recv(length - len(body))
+            if not chunk:
+                return None
+            body += chunk
+        return head[0], body
+
+    def _serve(self):
+        conn, _ = self.sock.accept()
+        pkt = self._read_packet(conn)
+        assert pkt and pkt[0] >> 4 == 1  # CONNECT
+        conn.sendall(bytes([0x20, 0x02, 0x00, 0x00]))  # CONNACK accepted
+        while True:
+            pkt = self._read_packet(conn)
+            if pkt is None:
+                return
+            ptype, body = pkt
+            if ptype >> 4 == 3:  # PUBLISH
+                tlen = struct.unpack(">H", body[:2])[0]
+                topic = body[2 : 2 + tlen].decode()
+                self.published.append((topic, body[2 + tlen :]))
+            elif ptype >> 4 == 12:  # PINGREQ
+                conn.sendall(bytes([0xD0, 0x00]))
+            elif ptype >> 4 == 14:  # DISCONNECT
+                conn.close()
+                return
+
+
+class TestMqtt:
+    def test_publish_json_and_frames(self):
+        broker = FakeBroker()
+        dest = MqttDestination("127.0.0.1", broker.port, topic="evam/t")
+        dest.publish({"objects": [], "timestamp": 7}, frame=b"\x01\x02")
+        dest.close()
+        broker.thread.join(timeout=5)
+        topics = [t for t, _ in broker.published]
+        assert topics == ["evam/t", "evam/t/frames"]
+        meta = json.loads(broker.published[0][1])
+        assert meta["timestamp"] == 7
+        assert broker.published[1][1] == b"\x01\x02"
+
+    def test_unreachable_broker_drops_not_raises(self):
+        dest = MqttDestination("127.0.0.1", 1, topic="x", max_backoff=0.1)
+        for _ in range(3):
+            dest.publish({"n": 1})
+        assert dest.dropped >= 1
+        dest.close()
+
+
+class TestZmq:
+    def test_json_blob_framing(self):
+        port_probe = socket.socket()
+        port_probe.bind(("127.0.0.1", 0))
+        port = port_probe.getsockname()[1]
+        port_probe.close()
+        endpoint = f"tcp://127.0.0.1:{port}"
+
+        import zmq
+
+        dest = ZmqDestination(endpoint, topic="cam1")
+        ctx = zmq.Context.instance()
+        sub = ctx.socket(zmq.SUB)
+        sub.connect(endpoint)
+        sub.setsockopt(zmq.SUBSCRIBE, b"cam1")
+        sub.setsockopt(zmq.RCVTIMEO, 5000)
+        time.sleep(0.3)  # late-joiner sync
+        dest.publish({"k": 1}, frame=b"blob")
+        parts = sub.recv_multipart()
+        assert parts[0] == b"cam1"
+        assert json.loads(parts[1]) == {"k": 1}
+        assert parts[2] == b"blob"
+        sub.close(0)
+        dest.close()
+
+
+class TestFileAndFactory:
+    def test_json_lines(self, tmp_path):
+        p = tmp_path / "r.jsonl"
+        d = FileDestination(str(p))
+        d.publish({"a": 1})
+        d.publish({"a": 2})
+        d.close()
+        rows = [json.loads(l) for l in p.read_text().splitlines()]
+        assert rows == [{"a": 1}, {"a": 2}]
+
+    def test_json_array(self, tmp_path):
+        p = tmp_path / "r.json"
+        d = FileDestination(str(p), fmt="json")
+        d.publish({"a": 1})
+        d.publish({"a": 2})
+        d.close()
+        assert json.loads(p.read_text()) == [{"a": 1}, {"a": 2}]
+
+    def test_factory(self, tmp_path):
+        assert isinstance(create_destination(None), NullDestination)
+        assert isinstance(
+            create_destination({"type": "file", "path": str(tmp_path / "x")}),
+            FileDestination,
+        )
+        with pytest.raises(ValueError):
+            create_destination({"type": "carrier-pigeon"})
+
+
+class TestEncode:
+    def test_jpeg_roundtrip(self):
+        frame = np.random.default_rng(0).integers(
+            0, 255, (32, 32, 3), np.uint8)
+        data = encode_frame(frame, "jpeg", 90)
+        assert data[:2] == b"\xff\xd8"  # JPEG SOI
+        import cv2
+
+        back = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        assert back.shape == frame.shape
+
+    def test_png_lossless(self):
+        frame = np.random.default_rng(1).integers(
+            0, 255, (16, 16, 3), np.uint8)
+        data = encode_frame(frame, "png")
+        import cv2
+
+        back = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        np.testing.assert_array_equal(back, frame)
+
+    def test_raw_and_bad_level(self):
+        frame = np.zeros((4, 4, 3), np.uint8)
+        assert encode_frame(frame, None) == frame.tobytes()
+        with pytest.raises(ValueError):
+            encode_frame(frame, "jpeg", 200)
+        with pytest.raises(ValueError):
+            encode_frame(frame, "webp")

@@ -1,0 +1,189 @@
+"""REST layer tests: full request→stream→destination flow against the
+reference API surface (charts/templates/NOTES.txt:7-21) using synthetic
+sources and small models on the CPU mesh."""
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from evam_tpu.config import Settings
+from evam_tpu.engine import EngineHub
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+from evam_tpu.server.app import build_app
+from evam_tpu.server.registry import PipelineRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def registry(eight_devices, tmp_path_factory):
+    settings = Settings(
+        pipelines_dir=str(REPO / "pipelines"),
+        state_dir=str(tmp_path_factory.mktemp("state")),
+    )
+    model_registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                                   width_overrides=NARROW)
+    hub = EngineHub(model_registry, plan=build_mesh(), max_batch=16,
+                    deadline_ms=4.0)
+    reg = PipelineRegistry(settings, hub=hub)
+    yield reg
+    reg.stop_all()
+
+
+def _request(registry, method, path, body=None):
+    async def go():
+        app = build_app(registry)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.request(method, path, json=body)
+            try:
+                data = await resp.json()
+            except Exception:
+                data = await resp.text()
+            return resp.status, data
+
+    return asyncio.run(go())
+
+
+def _wait_state(registry, iid, states=("COMPLETED",), timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        inst = registry.get_instance(iid)
+        if inst is not None and inst.state.value in states:
+            return inst
+        time.sleep(0.2)
+    raise AssertionError(
+        f"instance {iid} did not reach {states}; "
+        f"now {registry.get_instance(iid).state}"
+    )
+
+
+class TestRoutes:
+    def test_list_pipelines(self, registry):
+        status, data = _request(registry, "GET", "/pipelines")
+        assert status == 200
+        names = {(p["name"], p["version"]) for p in data}
+        assert ("object_detection", "person_vehicle_bike") in names
+        assert len(names) >= 11
+
+    def test_describe(self, registry):
+        status, data = _request(
+            registry, "GET", "/pipelines/object_detection/person")
+        assert status == 200
+        assert "parameters" in data
+
+    def test_describe_missing_404(self, registry):
+        status, data = _request(registry, "GET", "/pipelines/nope/v1")
+        assert status == 404
+
+    def test_models(self, registry):
+        status, data = _request(registry, "GET", "/models")
+        assert status == 200
+        assert "object_detection/person_vehicle_bike" in data
+
+    def test_healthz_and_metrics(self, registry):
+        assert _request(registry, "GET", "/healthz")[0] == 200
+        status, text = _request(registry, "GET", "/metrics")
+        assert status == 200
+
+
+class TestInstanceLifecycle:
+    def test_full_flow(self, registry, tmp_path):
+        out_file = tmp_path / "results.jsonl"
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=6", "type": "uri"},
+            "destination": {
+                "metadata": {"type": "file", "path": str(out_file)}
+            },
+            "parameters": {"detection-properties": {"threshold": 0.0}},
+        }
+        status, iid = _request(
+            registry, "POST", "/pipelines/object_detection/person", body)
+        assert status == 200, iid
+        inst = _wait_state(registry, iid)
+
+        status, data = _request(
+            registry, "GET",
+            f"/pipelines/object_detection/person/{iid}/status")
+        assert status == 200
+        assert data["state"] == "COMPLETED"
+        assert data["id"] == iid
+
+        lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+        assert len(lines) == 6
+        meta = lines[0]
+        # §6-schema metadata (reference charts/README.md:117)
+        assert set(meta) >= {"objects", "resolution", "source", "timestamp"}
+        assert meta["resolution"] == {"height": 96, "width": 96}
+
+    def test_bad_body_400(self, registry):
+        status, data = _request(
+            registry, "POST", "/pipelines/object_detection/person", {})
+        assert status == 400
+
+    def test_unknown_pipeline_404(self, registry):
+        status, data = _request(
+            registry, "POST", "/pipelines/nope/v1",
+            {"source": {"uri": "synthetic://64x64@30?count=1"}})
+        assert status == 404
+
+    def test_delete_aborts(self, registry):
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=100000",
+                       "realtime": True},
+            "destination": {"metadata": {"type": "null"}},
+        }
+        status, iid = _request(
+            registry, "POST", "/pipelines/video_decode/app_dst", body)
+        assert status == 200
+        status, data = _request(
+            registry, "DELETE", f"/pipelines/video_decode/app_dst/{iid}")
+        assert status == 200
+        inst = _wait_state(registry, iid, states=("ABORTED", "COMPLETED"))
+        assert inst.state.value in ("ABORTED", "COMPLETED")
+
+    def test_statuses_listing(self, registry):
+        status, data = _request(registry, "GET", "/pipelines/status")
+        assert status == 200
+        assert isinstance(data, list) and data
+
+
+class TestPersistence:
+    def test_state_file_roundtrip(self, registry):
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=100000",
+                       "realtime": True},
+            "destination": {"metadata": {"type": "null"}},
+        }
+        status, iid = _request(
+            registry, "POST", "/pipelines/video_decode/app_dst", body)
+        assert status == 200
+        state_file = Path(registry.settings.state_dir) / "streams.json"
+        entries = json.loads(state_file.read_text())
+        assert any(e["pipeline"] == "video_decode" for e in entries)
+        _request(registry, "DELETE", f"/pipelines/video_decode/app_dst/{iid}")
+
+    def test_completed_streams_not_resumed(self, registry):
+        # A finished stream must leave the state file (no duplicate
+        # replay on restart); a drain (stop_all) must NOT rewrite it.
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=2", "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+        }
+        status, iid = _request(
+            registry, "POST", "/pipelines/video_decode/app_dst", body)
+        assert status == 200
+        _wait_state(registry, iid)
+        time.sleep(0.3)  # on_finish persist
+        state_file = Path(registry.settings.state_dir) / "streams.json"
+        entries = json.loads(state_file.read_text())
+        assert not any(
+            e["request"]["source"]["uri"].endswith("count=2") for e in entries
+        )

@@ -255,3 +255,51 @@ def test_fusion_skipped_when_disabled(loader, hub):
     stages = build_stages(stages_spec, hub, fuse=False)
     kinds = [type(s).__name__ for s in stages]
     assert "DetectStage" in kinds and "ClassifyStage" in kinds
+
+
+def test_fusion_skipped_for_reclassify_interval(loader, hub):
+    # reclassify-interval > 1 is host-side schedule state the fused
+    # program can't express: build must fall back to separate stages.
+    from evam_tpu.graph import resolve_parameters
+    from evam_tpu.stages import build_stages
+    from evam_tpu.stages.infer import ClassifyStage, DetectStage
+
+    spec = loader.get("object_classification", "vehicle_attributes")
+    stages_spec, _ = resolve_parameters(spec, {"reclassify-interval": 3})
+    stages = build_stages(stages_spec, hub)
+    kinds = [type(s).__name__ for s in stages]
+    assert "FusedDetectClassifyStage" not in kinds
+    assert "DetectStage" in kinds and "ClassifyStage" in kinds
+
+
+def test_fused_object_class_filter_in_program(hub):
+    # The object-class filter runs inside the fused XLA program: rows
+    # of other classes must have an all-zero probability block.
+    import jax
+
+    from evam_tpu.engine.steps import build_detect_classify_step
+
+    det = hub.model("object_detection/person_vehicle_bike")
+    cls = hub.model("object_classification/vehicle_attributes")
+    vehicle_ids = tuple(
+        i for i, lbl in enumerate(det.labels) if lbl == "vehicle"
+    )
+    step = build_detect_classify_step(
+        det, cls, wire_format="bgr", score_threshold=0.0,
+        allowed_label_ids=vehicle_ids,
+    )
+    frames = np.random.default_rng(0).integers(
+        0, 255, (2,) + (det.preprocess.height, det.preprocess.width, 3),
+        dtype=np.uint8,
+    )
+    out = np.asarray(jax.jit(step)(
+        {"det": det.params, "cls": cls.params}, frames=frames))
+    labels = out[..., 5].astype(int)
+    valid = out[..., 6] > 0.5
+    probs = out[..., 7:]
+    classified = probs.sum(-1) > 0.5
+    # no non-vehicle row may carry classification probs
+    for b in range(out.shape[0]):
+        for k in range(out.shape[1]):
+            if classified[b, k]:
+                assert valid[b, k] and labels[b, k] in vehicle_ids
