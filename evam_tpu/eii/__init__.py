@@ -1,0 +1,17 @@
+"""EII-mode service layer — counterpart of the reference's ``evas``
+package (`python3 -m evas`, reference run.sh:26-27): headless service
+configured from a config store, one pipeline auto-started at boot,
+frames+metadata published over the brokerless message bus
+(reference evas/manager.py, evas/publisher.py, evas/subscriber.py)."""
+
+from evam_tpu.eii.configmgr import ConfigMgr
+from evam_tpu.eii.manager import EiiManager, run_eii_service
+from evam_tpu.eii.msgbus import MsgBusPublisher, MsgBusSubscriber
+
+__all__ = [
+    "ConfigMgr",
+    "EiiManager",
+    "MsgBusPublisher",
+    "MsgBusSubscriber",
+    "run_eii_service",
+]

@@ -1,0 +1,127 @@
+"""ConfigMgr: the EII ConfigManager counterpart.
+
+The reference reads service config from etcd through the EII
+ConfigManager C binding (`cfg.ConfigMgr()` at evas/__main__.py:34;
+app config + publisher/subscriber interfaces at evas/manager.py:58,
+80-91; TLS certs via CONFIGMGR_* env, eii/docker-compose.yml:61-63).
+etcd3 is not in this image, so the store is a local JSON file with the
+same two-section shape as the reference's eii/config.json
+(``config`` + ``interfaces``) plus an mtime-poll watcher that delivers
+hot-reload callbacks — the reference declares this callback but stubs
+it (`_config_update_callback`, evas/manager.py:157-162); here it
+works.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Any, Callable
+
+from evam_tpu.obs import get_logger
+
+log = get_logger("eii.configmgr")
+
+DEFAULT_CONFIG: dict[str, Any] = {
+    "config": {
+        "source": "gstreamer",
+        "pipeline": "object_detection/person_vehicle_bike",
+        "source_parameters": {
+            "type": "uri",
+            "uri": "synthetic://768x432@30",
+        },
+        "publish_frame": False,
+        "encoding": {"type": "jpeg", "level": 95},
+        "model_parameters": {},
+    },
+    "interfaces": {
+        "Publishers": [
+            {
+                "Name": "default",
+                "Type": "zmq_tcp",
+                "EndPoint": "0.0.0.0:65114",
+                "Topics": ["camera1_stream_results"],
+                "AllowedClients": ["*"],
+            }
+        ],
+        "Subscribers": [],
+    },
+}
+
+
+class ConfigMgr:
+    def __init__(
+        self,
+        config_file: str | Path | None = None,
+        watch_interval_s: float = 2.0,
+    ):
+        self.config_file = Path(config_file) if config_file else None
+        self.watch_interval_s = watch_interval_s
+        self._data = self._load()
+        self._mtime = self._stat_mtime()
+        self._watcher: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._callbacks: list[Callable[[dict], None]] = []
+
+    def _load(self) -> dict[str, Any]:
+        if self.config_file and self.config_file.exists():
+            return json.loads(self.config_file.read_text())
+        return json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
+
+    def _stat_mtime(self) -> float:
+        try:
+            return self.config_file.stat().st_mtime if self.config_file else 0.0
+        except OSError:
+            return 0.0
+
+    # ---------------------------------------------------- reference API
+
+    def get_app_config(self) -> dict[str, Any]:
+        """App-level config (reference get_app_config().get_dict())."""
+        return self._data.get("config", {})
+
+    def get_num_publishers(self) -> int:
+        return len(self._data.get("interfaces", {}).get("Publishers", []))
+
+    def get_num_subscribers(self) -> int:
+        return len(self._data.get("interfaces", {}).get("Subscribers", []))
+
+    def get_publisher_by_index(self, i: int) -> dict[str, Any]:
+        return self._data["interfaces"]["Publishers"][i]
+
+    def get_subscriber_by_index(self, i: int) -> dict[str, Any]:
+        return self._data["interfaces"]["Subscribers"][i]
+
+    # -------------------------------------------------------- watching
+
+    def watch(self, callback: Callable[[dict], None]) -> None:
+        """Hot-reload hook (working version of the reference's stubbed
+        `_config_update_callback`)."""
+        self._callbacks.append(callback)
+        if self._watcher is None and self.config_file is not None:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, name="configmgr-watch", daemon=True
+            )
+            self._watcher.start()
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.watch_interval_s):
+            mtime = self._stat_mtime()
+            if mtime != self._mtime:
+                self._mtime = mtime
+                try:
+                    self._data = self._load()
+                except (OSError, json.JSONDecodeError) as exc:
+                    log.warning("config reload failed: %s", exc)
+                    continue
+                log.info("config file changed; notifying %d watcher(s)",
+                         len(self._callbacks))
+                for cb in self._callbacks:
+                    try:
+                        cb(self._data)
+                    except Exception as exc:  # noqa: BLE001
+                        log.warning("config callback error: %s", exc)
+
+    def close(self) -> None:
+        self._stop.set()

@@ -1,0 +1,230 @@
+"""EiiManager: the EvasManager counterpart (reference
+evas/manager.py:47-162).
+
+Boot sequence mirrors the reference call stack (SURVEY.md §3.1):
+read app config → optional msgbus-ingest subscriber → msgbus
+publisher → start ONE configured pipeline → run_forever. Differences
+are the TPU inversions: the pipeline runs on the shared
+PipelineRegistry/EngineHub instead of a per-stream OpenVINO engine,
+and the working config watcher replaces the reference's stubbed
+`_config_update_callback` (evas/manager.py:157-162) with a real
+restart-on-change.
+
+Published message shape matches reference evas/publisher.py:183-230:
+``(meta, frame-bytes)`` tuple when ``publish_frame`` else meta only,
+meta carrying img_handle / width / height / channels / encoding info
+and the per-region ``gva_meta`` list (rect in pixels, object_id,
+tensors with name/confidence/label_id/label).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+from typing import Any
+
+import numpy as np
+
+from evam_tpu.config import Settings
+from evam_tpu.eii.configmgr import ConfigMgr
+from evam_tpu.eii.msgbus import MsgBusPublisher, MsgBusSubscriber
+from evam_tpu.media.source import AppSource
+from evam_tpu.obs import get_logger, metrics
+from evam_tpu.publish.encode import encode_frame
+from evam_tpu.server.registry import PipelineRegistry
+from evam_tpu.stages.context import FrameContext
+
+log = get_logger("eii.manager")
+
+
+def _gva_meta(ctx: FrameContext) -> list[dict[str, Any]]:
+    """Regions → the reference's gva_meta rects
+    (evas/publisher.py:193-230)."""
+    out = []
+    for r in ctx.regions:
+        x, y, w, h = r.rect(ctx.width, ctx.height)
+        entry: dict[str, Any] = {
+            "x": x, "y": y, "width": w, "height": h,
+            "object_id": r.object_id,
+            "tensor": [
+                {
+                    "name": t.name,
+                    "confidence": t.confidence,
+                    "label_id": t.label_id,
+                    "label": t.label,
+                }
+                for t in r.tensors
+            ],
+        }
+        out.append(entry)
+    return out
+
+
+class EiiManager:
+    def __init__(
+        self,
+        settings: Settings,
+        cfg_mgr: ConfigMgr | None = None,
+        registry: PipelineRegistry | None = None,
+    ):
+        self.settings = settings
+        self.cfg = cfg_mgr or ConfigMgr(os.environ.get("EVAM_EII_CONFIG"))
+        self.registry = registry or PipelineRegistry(settings)
+        self._stop = threading.Event()
+        self._sub_thread: threading.Thread | None = None
+        self.subscriber: MsgBusSubscriber | None = None
+        self.app_source: AppSource | None = None
+        self.instance = None
+
+        app_cfg = self.cfg.get_app_config()
+        self.publish_frame = bool(app_cfg.get("publish_frame", False))
+        enc = app_cfg.get("encoding") or {}
+        self.enc_type = enc.get("type")
+        self.enc_level = enc.get("level")
+
+        pub_cfg = self.cfg.get_publisher_by_index(0)
+        topic = pub_cfg.get("Topics", ["evam_tpu"])[0]
+        self.publisher = MsgBusPublisher(pub_cfg, topic)
+
+        self._start_pipeline(app_cfg)
+        # Working hot-reload: restart the pipeline when the config
+        # store changes.
+        self.cfg.watch(self._on_config_update)
+
+    # ------------------------------------------------------- pipeline
+
+    def _start_pipeline(self, app_cfg: dict[str, Any]) -> None:
+        pipeline = app_cfg.get(
+            "pipeline", "object_detection/person_vehicle_bike"
+        )
+        name, _, version = pipeline.partition("/")
+        request: dict[str, Any] = {
+            "source": dict(app_cfg.get("source_parameters") or {}),
+            "parameters": dict(app_cfg.get("model_parameters") or {}),
+        }
+        source_obj = None
+        if app_cfg.get("source") == "msgbus":
+            # Frames arrive over the bus instead of a decoder
+            # (reference evas/manager.py:77-88 + subscriber.py).
+            sub_cfg = self.cfg.get_subscriber_by_index(0)
+            sub_topic = sub_cfg.get("Topics", ["camera1_stream"])[0]
+            self.subscriber = MsgBusSubscriber(sub_cfg, sub_topic)
+            self.app_source = AppSource(maxsize=64)
+            source_obj = self.app_source
+            request["source"] = {"type": "application"}
+            self._sub_thread = threading.Thread(
+                target=self._ingest_loop, name="msgbus-ingest", daemon=True
+            )
+            self._sub_thread.start()
+        # Pipelines without a metapublish stage (appsink-terminated,
+        # like the reference's EII variants ending in appsink —
+        # eii/pipelines/.../pipeline.json:6) publish from the sink.
+        spec = self.registry.loader.get(name, version)
+        from evam_tpu.graph.spec import StageKind
+
+        has_publish = spec is not None and any(
+            s.kind == StageKind.PUBLISH for s in spec.stages
+        )
+        self.instance = self.registry.start_instance(
+            name, version, request,
+            publish_fn=self._publish, source=source_obj,
+            sink_fn=None if has_publish else self._publish,
+        )
+        log.info("EII pipeline %s started (instance %s)",
+                 pipeline, self.instance.id[:8])
+
+    def _on_config_update(self, data: dict[str, Any]) -> None:
+        log.info("config changed: restarting pipeline")
+        if self.instance is not None:
+            self.registry.stop_instance(self.instance.id)
+            self.instance.wait(timeout=10)
+        self._start_pipeline(self.cfg.get_app_config())
+
+    # -------------------------------------------------------- publish
+
+    def _publish(self, ctx: FrameContext) -> None:
+        meta: dict[str, Any] = {
+            "img_handle": secrets.token_hex(6),
+            "width": ctx.width,
+            "height": ctx.height,
+            "channels": 3,
+            "caps": (
+                f"video/x-raw, format=BGR, width={ctx.width}, "
+                f"height={ctx.height}"
+            ),
+            "gva_meta": _gva_meta(ctx),
+        }
+        if ctx.metadata:
+            # Keep the EVA-schema fields too (timestamp, source, UDF
+            # events) — consumers of either dialect see their keys.
+            for k, v in ctx.metadata.items():
+                meta.setdefault(k, v)
+        blob = None
+        if self.publish_frame and ctx.frame is not None:
+            if self.enc_type:
+                blob = encode_frame(ctx.frame, self.enc_type, self.enc_level)
+                meta["encoding_type"] = self.enc_type
+                meta["encoding_level"] = self.enc_level
+            else:
+                blob = np.ascontiguousarray(ctx.frame).tobytes()
+        self.publisher.publish(meta, blob)
+        metrics.inc("evam_eii_published")
+
+    # --------------------------------------------------------- ingest
+
+    def _ingest_loop(self) -> None:
+        assert self.subscriber is not None and self.app_source is not None
+        while not self._stop.is_set():
+            msg = self.subscriber.recv()
+            if msg is None:
+                continue
+            meta, blob = msg
+            if blob is None:
+                continue
+            try:
+                h = int(meta.get("height", 0))
+                w = int(meta.get("width", 0))
+                if meta.get("encoding_type"):
+                    import cv2
+
+                    frame = cv2.imdecode(
+                        np.frombuffer(blob, np.uint8), cv2.IMREAD_COLOR
+                    )
+                else:
+                    frame = np.frombuffer(blob, np.uint8).reshape(h, w, 3)
+                self.app_source.push(frame)
+            except Exception as exc:  # noqa: BLE001 — bad frame, keep going
+                log.warning("msgbus ingest: dropped bad frame (%s)", exc)
+                metrics.inc("evam_eii_ingest_drops")
+
+    # ------------------------------------------------------ lifecycle
+
+    def run_forever(self) -> None:
+        """Block until stopped (reference manager.run_forever →
+        PipelineServer.wait, evas/manager.py:151-155)."""
+        try:
+            while not self._stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.app_source is not None:
+            self.app_source.end()
+        if self.subscriber is not None:
+            self.subscriber.close()
+        self.cfg.close()
+        self.registry.stop_all()
+        self.publisher.close()
+
+
+def run_eii_service(settings: Settings) -> int:
+    """Blocking entrypoint for ``evam-tpu serve --mode EII``."""
+    manager = EiiManager(settings)
+    log.info("EII service running")
+    manager.run_forever()
+    return 0

@@ -1,0 +1,123 @@
+"""Message bus: brokerless ZeroMQ pub/sub with EII-style interface
+configs.
+
+The reference's EII MsgBus (C library + Python binding, installed as
+.debs at reference Dockerfile:57-65) carries ``(json-meta, blob)``
+pairs between services over ``zmq_tcp`` (cross-host, EndPoint
+host:port — eii/config.json:17-19) or ``zmq_ipc`` (same host, socket
+dir — eii/config.json:31-32), with ``zmq_recv_hwm`` backpressure
+(:37) and per-topic ``AllowedClients`` ACLs (:23-25). This module
+speaks the same interface-config dialect over pyzmq:
+
+    {"Type": "zmq_tcp", "EndPoint": "0.0.0.0:65114",
+     "Topics": ["camera1_stream_results"], "AllowedClients": ["*"],
+     "zmq_recv_hwm": 50}
+
+Wire framing: multipart [topic, meta-json, blob?] — topic first so
+ZMQ's prefix subscription filters server-side (the C MsgBus does the
+same). The AllowedClients ACL maps to CURVE auth in the reference's
+prod mode; dev mode (DEV_MODE=true, no TLS, reference
+eii/docker-compose.yml:61-63) is the supported mode here and the ACL
+is recorded but not enforced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from evam_tpu.obs import get_logger
+
+log = get_logger("eii.msgbus")
+
+SOCKET_DIR = os.environ.get("EVAM_SOCKET_DIR", "/tmp/evam_sockets")
+
+
+def _endpoint(cfg: dict[str, Any], topic: str, bind: bool) -> str:
+    btype = cfg.get("Type", "zmq_tcp")
+    if btype == "zmq_tcp":
+        host_port = cfg.get("EndPoint", "127.0.0.1:65114")
+        if bind:
+            return f"tcp://{host_port}"
+        host, _, port = str(host_port).partition(":")
+        host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        return f"tcp://{host}:{port}"
+    if btype == "zmq_ipc":
+        sock_dir = cfg.get("EndPoint", SOCKET_DIR)
+        os.makedirs(sock_dir, exist_ok=True)
+        return f"ipc://{sock_dir}/{topic}"
+    raise ValueError(f"unsupported msgbus type '{btype}'")
+
+
+class MsgBusPublisher:
+    """Publish ``(meta, blob)`` on one topic (reference
+    evas/publisher.py:63-64, 246-250 semantics: message is either a
+    meta dict or a (meta, frame-bytes) tuple)."""
+
+    def __init__(self, cfg: dict[str, Any], topic: str):
+        import zmq
+
+        self.topic = topic
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.setsockopt(zmq.SNDHWM, int(cfg.get("zmq_send_hwm", 1000)))
+        self._sock.setsockopt(zmq.LINGER, 0)
+        ep = _endpoint(cfg, topic, bind=True)
+        self._sock.bind(ep)
+        self.allowed_clients = list(cfg.get("AllowedClients", ["*"]))
+        log.info("msgbus publisher topic=%s endpoint=%s", topic, ep)
+
+    def publish(self, meta: dict, blob: bytes | None = None) -> None:
+        import zmq
+
+        parts = [
+            self.topic.encode(),
+            json.dumps(meta, separators=(",", ":")).encode(),
+        ]
+        if blob is not None:
+            parts.append(blob)
+        try:
+            self._sock.send_multipart(parts, flags=zmq.NOBLOCK)
+        except zmq.Again:
+            pass  # slow consumer: drop, never stall the pipeline
+
+    def close(self) -> None:
+        self._sock.close(0)
+
+
+class MsgBusSubscriber:
+    """Blocking ``recv() -> (meta, blob|None)`` on one topic
+    (reference evas/subscriber.py:92-93)."""
+
+    def __init__(self, cfg: dict[str, Any], topic: str,
+                 recv_timeout_ms: int = 1000):
+        import zmq
+
+        self.topic = topic
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.SUB)
+        self._sock.setsockopt(zmq.RCVHWM, int(cfg.get("zmq_recv_hwm", 1000)))
+        self._sock.setsockopt(zmq.SUBSCRIBE, topic.encode())
+        self._sock.setsockopt(zmq.RCVTIMEO, recv_timeout_ms)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        ep = _endpoint(cfg, topic, bind=False)
+        self._sock.connect(ep)
+        log.info("msgbus subscriber topic=%s endpoint=%s", topic, ep)
+
+    def recv(self) -> tuple[dict, bytes | None] | None:
+        """One message, or None on timeout (lets callers poll a stop
+        flag — the reference thread loops on a stop Event the same
+        way, evas/subscriber.py:84-88)."""
+        import zmq
+
+        try:
+            parts = self._sock.recv_multipart()
+        except zmq.Again:
+            return None
+        meta = json.loads(parts[1]) if len(parts) > 1 else {}
+        blob = parts[2] if len(parts) > 2 else None
+        return meta, blob
+
+    def close(self) -> None:
+        self._sock.close(0)

@@ -1,0 +1,36 @@
+"""Frame annotation: draw detection overlays for re-streaming.
+
+The reference's RTSP re-stream serves the *annotated* stream (watermarked
+frames from the pipeline, reference docker-compose.yml:49-50); this is
+the host-side box/label painter used before JPEG encode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from evam_tpu.stages.context import FrameContext
+
+_BOX = (64, 220, 64)
+_TEXT = (255, 255, 255)
+
+
+def annotate_frame(ctx: FrameContext) -> np.ndarray:
+    """BGR copy of ctx.frame with rects + labels painted."""
+    import cv2
+
+    frame = ctx.frame.copy()
+    h, w = frame.shape[:2]
+    for r in ctx.regions:
+        x, y, bw, bh = r.rect(w, h)
+        cv2.rectangle(frame, (x, y), (x + bw, y + bh), _BOX, 2)
+        label = r.label
+        if r.object_id is not None:
+            label = f"{label} #{r.object_id}"
+        attrs = [t.label for t in r.tensors if not t.is_detection and t.label]
+        if attrs:
+            label += " " + "/".join(attrs[:2])
+        cv2.putText(frame, f"{label} {r.confidence:.2f}",
+                    (x, max(12, y - 4)), cv2.FONT_HERSHEY_SIMPLEX,
+                    0.45, _TEXT, 1, cv2.LINE_AA)
+    return frame

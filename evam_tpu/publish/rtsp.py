@@ -1,0 +1,285 @@
+"""RTSP re-streaming server: RTP/MJPEG (RFC 2435) over TCP interleaved.
+
+The reference re-streams annotated pipelines at
+``rtsp://<host>:8554/<path>`` when ENABLE_RTSP=true (reference
+docker-compose.yml:45,49-50; per-request path via
+``destination.frame.{type:rtsp, path}``). The base image uses
+GStreamer's C RTSP server; this is a from-scratch implementation:
+RTSP handshake (OPTIONS/DESCRIBE/SETUP/PLAY/TEARDOWN), SDP with the
+static JPEG payload type 26, and RFC 2435 JPEG packetization with
+in-band quantization tables (Q=255), interleaved on the RTSP TCP
+connection ('$' channel framing) so no UDP ports are needed.
+Verified against ffprobe/OpenCV's FFmpeg RTSP client (tests).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from evam_tpu.obs import get_logger
+
+log = get_logger("publish.rtsp")
+
+JPEG_PT = 26          # RTP/AVP static payload type for JPEG
+RTP_CLOCK = 90_000
+MAX_FRAG = 1400       # payload bytes per RTP packet
+
+
+# ---------------------------------------------------------------- JPEG
+
+def parse_jpeg(data: bytes):
+    """Extract (width, height, qtables, scan_bytes) from a baseline
+    JFIF buffer (the shape RFC 2435 needs: tables sent in-band,
+    entropy-coded scan re-framed as RTP payloads)."""
+    if data[:2] != b"\xff\xd8":
+        raise ValueError("not a JPEG")
+    i = 2
+    qtables: list[bytes] = []
+    width = height = 0
+    while i < len(data):
+        if data[i] != 0xFF:
+            raise ValueError("bad marker")
+        marker = data[i + 1]
+        if marker == 0xD9:  # EOI
+            break
+        seg_len = struct.unpack(">H", data[i + 2 : i + 4])[0]
+        seg = data[i + 4 : i + 2 + seg_len]
+        if marker == 0xDB:  # DQT — may hold several 65-byte tables
+            j = 0
+            while j < len(seg):
+                precision = seg[j] >> 4
+                tbl_len = 64 * (2 if precision else 1)
+                qtables.append(seg[j + 1 : j + 1 + tbl_len])
+                j += 1 + tbl_len
+        elif marker in (0xC0, 0xC1):  # SOF0/1 (baseline)
+            height, width = struct.unpack(">HH", seg[1:5])
+        elif marker == 0xDA:  # SOS — scan follows until EOI
+            scan = data[i + 2 + seg_len : ]
+            if scan.endswith(b"\xff\xd9"):
+                scan = scan[:-2]
+            return width, height, qtables, scan
+        i += 2 + seg_len
+    raise ValueError("no SOS segment")
+
+
+def packetize_jpeg(jpeg: bytes, seq: int, timestamp: int, ssrc: int):
+    """RFC 2435 packets for one frame. Returns (packets, next_seq)."""
+    width, height, qtables, scan = parse_jpeg(jpeg)
+    qdata = b"".join(qtables)
+    packets = []
+    offset = 0
+    first = True
+    while offset < len(scan) or first:
+        frag = scan[offset : offset + MAX_FRAG]
+        last = offset + len(frag) >= len(scan)
+        header = struct.pack(
+            ">BBHII",
+            0x80,
+            (0x80 if last else 0) | JPEG_PT,
+            seq & 0xFFFF,
+            timestamp & 0xFFFFFFFF,
+            ssrc,
+        )
+        # JPEG payload header: tspec=0, 24-bit offset, type 1 (4:2:0),
+        # Q=255 (quantization tables in-band on the first fragment).
+        jpeg_hdr = struct.pack(
+            ">BBBBBB",
+            0,
+            (offset >> 16) & 0xFF, (offset >> 8) & 0xFF, offset & 0xFF,
+            1,
+            255,
+        ) + bytes([width // 8 & 0xFF, height // 8 & 0xFF])
+        body = header + jpeg_hdr
+        if first:
+            body += struct.pack(">BBH", 0, 0, len(qdata)) + qdata
+            first = False
+        body += frag
+        packets.append(body)
+        seq += 1
+        offset += len(frag)
+    return packets, seq
+
+
+# --------------------------------------------------------------- relay
+
+class FrameRelay:
+    """Latest-frame mailbox for one mount: pipeline pushes JPEGs,
+    client threads block for the next one (slow clients skip frames —
+    live semantics, never backpressure into the pipeline)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cond = threading.Condition()
+        self._jpeg: bytes | None = None
+        self._gen = 0
+
+    def push_jpeg(self, jpeg: bytes) -> None:
+        with self._cond:
+            self._jpeg = jpeg
+            self._gen += 1
+            self._cond.notify_all()
+
+    def push_bgr(self, frame_bgr: np.ndarray, quality: int = 80) -> None:
+        import cv2
+
+        ok, buf = cv2.imencode(
+            ".jpg", frame_bgr, [cv2.IMWRITE_JPEG_QUALITY, quality]
+        )
+        if ok:
+            self.push_jpeg(buf.tobytes())
+
+    def next_frame(self, last_gen: int, timeout: float = 2.0):
+        with self._cond:
+            self._cond.wait_for(lambda: self._gen != last_gen, timeout)
+            return self._jpeg, self._gen
+
+
+class RtspServer:
+    def __init__(self, port: int = 8554, host: str = "0.0.0.0"):
+        self.host = host
+        self.port = port
+        self._mounts: dict[str, FrameRelay] = {}
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]  # resolve port 0
+        self._sock.listen(8)
+        self._sock.settimeout(0.5)
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="rtsp-server", daemon=True
+        )
+        self._thread.start()
+        log.info("rtsp server on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+
+    def mount(self, path: str) -> FrameRelay:
+        path = path.strip("/")
+        with self._lock:
+            if path not in self._mounts:
+                self._mounts[path] = FrameRelay(path)
+            return self._mounts[path]
+
+    def unmount(self, path: str) -> None:
+        with self._lock:
+            self._mounts.pop(path.strip("/"), None)
+
+    # --------------------------------------------------------- serving
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_client, args=(conn, addr), daemon=True
+            ).start()
+
+    def _serve_client(self, conn: socket.socket, addr) -> None:
+        conn.settimeout(10)
+        session = f"{int(time.time()) & 0xFFFFFF:06x}"
+        playing_path = None
+        try:
+            buf = b""
+            while not self._stop.is_set():
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(2048)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                lines = head.decode("latin-1").split("\r\n")
+                method, url = lines[0].split(" ")[:2]
+                headers = {
+                    k.strip().lower(): v.strip()
+                    for k, v, in (l.split(":", 1) for l in lines[1:] if ":" in l)
+                }
+                cseq = headers.get("cseq", "0")
+                path = url.rstrip("/").split("/")[-1] if "/" in url else ""
+
+                if method == "OPTIONS":
+                    self._reply(conn, cseq, extra=(
+                        "Public: OPTIONS, DESCRIBE, SETUP, PLAY, TEARDOWN"))
+                elif method == "DESCRIBE":
+                    if self._mounts.get(path) is None:
+                        self._reply(conn, cseq, code="404 Not Found")
+                        continue
+                    sdp = (
+                        "v=0\r\n"
+                        f"o=- 0 0 IN IP4 {self.host}\r\n"
+                        "s=evam-tpu\r\n"
+                        "t=0 0\r\n"
+                        "m=video 0 RTP/AVP 26\r\n"
+                        "c=IN IP4 0.0.0.0\r\n"
+                        "a=control:streamid=0\r\n"
+                    )
+                    self._reply(conn, cseq, body=sdp,
+                                extra="Content-Type: application/sdp")
+                elif method == "SETUP":
+                    self._reply(conn, cseq, extra=(
+                        "Transport: RTP/AVP/TCP;unicast;interleaved=0-1\r\n"
+                        f"Session: {session}"))
+                elif method == "PLAY":
+                    self._reply(conn, cseq, extra=f"Session: {session}")
+                    playing_path = path or playing_path
+                    self._stream(conn, playing_path)
+                    return
+                elif method == "TEARDOWN":
+                    self._reply(conn, cseq, extra=f"Session: {session}")
+                    return
+                else:
+                    self._reply(conn, cseq, code="405 Method Not Allowed")
+        except (OSError, ValueError) as exc:
+            log.debug("rtsp client %s: %s", addr, exc)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _reply(conn, cseq, code="200 OK", extra="", body=""):
+        msg = f"RTSP/1.0 {code}\r\nCSeq: {cseq}\r\n"
+        if extra:
+            msg += extra + "\r\n"
+        if body:
+            msg += f"Content-Length: {len(body)}\r\n"
+        msg += "\r\n" + body
+        conn.sendall(msg.encode("latin-1"))
+
+    def _stream(self, conn: socket.socket, path: str) -> None:
+        relay = self._mounts.get(path)
+        if relay is None:
+            return
+        seq = 0
+        ssrc = 0x45564154  # "EVAT"
+        gen = 0
+        t0 = time.monotonic()
+        while not self._stop.is_set():
+            jpeg, gen = relay.next_frame(gen)
+            if jpeg is None:
+                continue
+            ts = int((time.monotonic() - t0) * RTP_CLOCK)
+            packets, seq = packetize_jpeg(jpeg, seq, ts, ssrc)
+            try:
+                for pkt in packets:
+                    # interleaved framing: '$', channel 0, length
+                    conn.sendall(b"$\x00" + struct.pack(">H", len(pkt)) + pkt)
+            except OSError:
+                return

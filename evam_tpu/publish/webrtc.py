@@ -1,0 +1,99 @@
+"""WebRTC signaling destination.
+
+The reference enables a WebRTC frame destination by pointing at an
+external signaling server (``ENABLE_WEBRTC`` +
+``WEBRTC_SIGNALING_SERVER`` ws endpoint, reference
+docker-compose.yml:51-52); media negotiation/transport live in that
+external stack, the service's job is to announce streams and feed
+frames. This client does the same over websockets: it registers each
+stream with the signaling server and, when asked to play, pushes
+JPEG frames as binary messages (the in-image stack has no DTLS/SRTP,
+so the frame channel is ws-binary MJPEG — the signaling contract and
+lifecycle match, the media encapsulation is documented here).
+
+Protocol (JSON text frames, binary for media):
+  -> {"type": "register", "stream": <name>}
+  <- {"type": "play", "stream": <name>}
+  -> binary JPEG frames until
+  <- {"type": "stop", "stream": <name>}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+from evam_tpu.obs import get_logger
+from evam_tpu.publish.rtsp import FrameRelay
+
+log = get_logger("publish.webrtc")
+
+
+class WebRtcSignaler:
+    def __init__(self, server_url: str, stream: str, relay: FrameRelay):
+        self.server_url = server_url
+        self.stream = stream
+        self.relay = relay
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"webrtc-{self.stream}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        import websockets
+
+        backoff = 1.0
+        while not self._stop.is_set():
+            try:
+                async with websockets.connect(self.server_url) as ws:
+                    backoff = 1.0
+                    await ws.send(json.dumps(
+                        {"type": "register", "stream": self.stream}))
+                    log.info("webrtc: registered %s at %s",
+                             self.stream, self.server_url)
+                    playing = False
+                    gen = 0
+                    while not self._stop.is_set():
+                        if playing:
+                            jpeg, gen = await asyncio.to_thread(
+                                self.relay.next_frame, gen, 0.5)
+                            if jpeg is not None:
+                                await ws.send(jpeg)
+                            msg = await self._poll(ws)
+                        else:
+                            msg = await self._poll(ws, timeout=0.5)
+                        if msg is None:
+                            continue
+                        data = json.loads(msg)
+                        if data.get("stream") not in (None, self.stream):
+                            continue
+                        if data.get("type") == "play":
+                            playing = True
+                        elif data.get("type") == "stop":
+                            playing = False
+            except Exception as exc:  # noqa: BLE001 — reconnect loop
+                if self._stop.is_set():
+                    return
+                log.warning("webrtc signaling (%s); retry in %.0fs",
+                            exc, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+
+    @staticmethod
+    async def _poll(ws, timeout: float = 0.001):
+        try:
+            return await asyncio.wait_for(ws.recv(), timeout)
+        except asyncio.TimeoutError:
+            return None

@@ -34,10 +34,18 @@ class ZmqDestination:
         # engine never blocks on a slow consumer.
         self._sock.setsockopt(zmq.SNDHWM, send_hwm)
         self._sock.setsockopt(zmq.LINGER, 0)
-        if bind:
-            self._sock.bind(endpoint)
-        else:
-            self._sock.connect(endpoint)
+        try:
+            if bind:
+                self._sock.bind(endpoint)
+            else:
+                self._sock.connect(endpoint)
+        except zmq.ZMQError as exc:
+            # Surfaces as a 400 at the REST layer (ValueError), e.g.
+            # two streams binding the same default endpoint.
+            self._sock.close(0)
+            raise ValueError(
+                f"zmq destination endpoint {endpoint}: {exc}"
+            ) from exc
         log.info("zmq pub %s endpoint %s", "bound" if bind else "connected",
                  endpoint)
 

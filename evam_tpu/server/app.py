@@ -67,6 +67,8 @@ def build_app(
             body: dict[str, Any] = await request.json()
         except json.JSONDecodeError:
             return _json_error(400, "request body must be JSON")
+        if not isinstance(body, dict):
+            return _json_error(400, "request body must be a JSON object")
         try:
             instance = await asyncio.to_thread(
                 registry.start_instance, name, version, body
@@ -146,7 +148,6 @@ def build_app(
 def run_server(settings: Settings) -> int:
     """Blocking entrypoint for ``evam-tpu serve --mode EVA``."""
     registry = PipelineRegistry(settings)
-    registry.resume()
     app = build_app(registry, stop_registry_on_shutdown=True)
     extras = []
     if settings.enable_rtsp:
@@ -154,8 +155,12 @@ def run_server(settings: Settings) -> int:
 
         rtsp = RtspServer(port=settings.rtsp_port)
         rtsp.start()
+        registry.rtsp = rtsp
         app["rtsp"] = rtsp
         extras.append(f"rtsp://0.0.0.0:{settings.rtsp_port}")
+    # Resume AFTER frame-destination servers exist: a resumed stream's
+    # destination.frame must re-mount on the live RTSP server.
+    registry.resume()
     log.info("REST serving on :%d %s", settings.rest_port,
              f"(+ {', '.join(extras)})" if extras else "")
     web.run_app(app, port=settings.rest_port, print=None)

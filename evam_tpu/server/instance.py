@@ -50,6 +50,7 @@ class StreamInstance:
         max_retries: int = 3,
         retry_backoff_s: float = 1.0,
         on_finish: Callable[["StreamInstance"], None] | None = None,
+        source: Any | None = None,
     ):
         self.id = str(uuid.uuid4())
         self.pipeline_name = pipeline_name
@@ -61,6 +62,11 @@ class StreamInstance:
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
         self.on_finish = on_finish
+        # Injected source (EII msgbus ingest): caller owns its
+        # lifecycle, so no retry-recreate — a failure is permanent.
+        self._injected_source = source
+        if source is not None:
+            self.max_retries = 0
 
         self.state = InstanceState.QUEUED
         self.error: str | None = None
@@ -112,8 +118,14 @@ class StreamInstance:
                     )
                     break
                 except Exception as exc:  # noqa: BLE001 — supervision boundary
+                    if self._stop.is_set():
+                        # stop() closing the source mid-read raises in
+                        # the reader; that's a deliberate abort, not a
+                        # stream failure.
+                        self.state = InstanceState.ABORTED
+                        break
                     attempts += 1
-                    if self._stop.is_set() or attempts > self.max_retries:
+                    if attempts > self.max_retries:
                         raise
                     # Source reconnect with backoff (reference leaves
                     # this as a TODO, evas/publisher.py:253-255).
@@ -144,7 +156,7 @@ class StreamInstance:
                     pass
 
     def _run_once(self) -> None:
-        source = create_source(
+        source = self._injected_source or create_source(
             self.request.get("source", {}),
             realtime=bool(self.request.get("source", {}).get("realtime", False)),
         )

@@ -11,6 +11,7 @@ lightweight adapters around shared per-model batch engines
 from __future__ import annotations
 
 import json
+import os
 import threading
 from pathlib import Path
 from typing import Any
@@ -55,10 +56,15 @@ class PipelineRegistry:
         self.instances: dict[str, StreamInstance] = {}
         self._lock = threading.Lock()
         self._draining = False
+        #: Optional RtspServer for destination.frame re-streaming
+        #: (set by run_server when ENABLE_RTSP, reference
+        #: docker-compose.yml:49-50).
+        self.rtsp = None
         self._state_file = (
             Path(settings.state_dir) / "streams.json"
             if settings.state_dir else None
         )
+        self._persist_lock = threading.Lock()
 
     # ----------------------------------------------------- definitions
 
@@ -90,15 +96,30 @@ class PipelineRegistry:
     # -------------------------------------------------------- instances
 
     def start_instance(
-        self, name: str, version: str, request: dict[str, Any]
+        self,
+        name: str,
+        version: str,
+        request: dict[str, Any],
+        publish_fn=None,
+        source=None,
+        sink_fn=None,
     ) -> StreamInstance:
+        """``publish_fn``/``source`` are embedder overrides (the EII
+        manager publishes (meta, frame) over the msgbus and injects an
+        app source fed by a subscriber — reference evas/manager.py
+        appsrc rewiring at :109-115)."""
         spec = self.loader.get(name, version)
         if spec is None:
             raise KeyError(f"pipeline {name}/{version} not found")
-        if "source" not in request or "uri" not in request.get("source", {}) \
-                and request.get("source", {}).get("type", "uri") == "uri":
-            raise RequestError("request.source.uri is required")
+        src = request.get("source")
+        if source is None:
+            if not isinstance(src, dict):
+                raise RequestError("request.source must be an object")
+            if "uri" not in src and src.get("type", "uri") == "uri":
+                raise RequestError("request.source.uri is required")
         params = request.get("parameters") or {}
+        # Resolve stages BEFORE opening the destination: a bad
+        # parameter must not truncate/leak the operator's output file.
         stage_specs, _ = resolve_parameters(spec, params)
         dest_cfg = (request.get("destination") or {}).get("metadata")
         destination = create_destination(dest_cfg)
@@ -109,13 +130,50 @@ class PipelineRegistry:
             request=request,
             destination=destination,
             on_finish=lambda _inst: self._persist(),
+            source=source,
         )
-        stages = build_stages(
-            stage_specs,
-            self.hub,
-            source_uri=request.get("source", {}).get("uri", ""),
-            publish_fn=lambda ctx: destination.publish(ctx.metadata),
-        )
+        meta_fn = publish_fn or (lambda ctx: destination.publish(ctx.metadata))
+        frame_cfg = (request.get("destination") or {}).get("frame") or {}
+        relay = None
+        if frame_cfg.get("type") == "rtsp" and self.rtsp is not None:
+            # Annotated re-stream at rtsp://host:8554/<path> (reference
+            # destination.frame contract + ENABLE_RTSP flow).
+            relay = self.rtsp.mount(frame_cfg.get("path") or name)
+        elif (frame_cfg.get("type") == "webrtc"
+              and self.settings.enable_webrtc
+              and self.settings.webrtc_signaling_server):
+            # Announce to the external signaling server (reference
+            # ENABLE_WEBRTC + WEBRTC_SIGNALING_SERVER flow,
+            # docker-compose.yml:51-52).
+            from evam_tpu.publish.rtsp import FrameRelay
+            from evam_tpu.publish.webrtc import WebRtcSignaler
+
+            relay = FrameRelay(frame_cfg.get("peer-id") or name)
+            WebRtcSignaler(
+                self.settings.webrtc_signaling_server,
+                relay.path, relay,
+            ).start()
+        if relay is not None:
+            from evam_tpu.publish.annotate import annotate_frame
+
+            base_fn = meta_fn
+
+            def meta_fn(ctx, _base=base_fn, _relay=relay):  # noqa: F811
+                _base(ctx)
+                if ctx.frame is not None:
+                    _relay.push_bgr(annotate_frame(ctx))
+
+        try:
+            stages = build_stages(
+                stage_specs,
+                self.hub,
+                source_uri=(src or {}).get("uri", "") if isinstance(src, dict) else "",
+                publish_fn=meta_fn,
+                sink_fn=sink_fn,
+            )
+        except Exception:
+            destination.close()  # already-opened file/socket must not leak
+            raise
         instance.stages = stages
         with self._lock:
             self.instances[instance.id] = instance
@@ -135,7 +193,9 @@ class PipelineRegistry:
         return inst
 
     def statuses(self) -> list[dict[str, Any]]:
-        return [i.status() for i in self.instances.values()]
+        with self._lock:
+            instances = list(self.instances.values())
+        return [i.status() for i in instances]
 
     def stop_all(self) -> None:
         # Shutdown drain must keep streams.json intact: these streams
@@ -156,27 +216,40 @@ class PipelineRegistry:
         container)."""
         if self._state_file is None or self._draining:
             return
+        with self._lock:
+            instances = list(self.instances.values())
         active = [
             {
                 "pipeline": i.pipeline_name,
                 "version": i.version,
                 "request": i.request,
             }
-            for i in self.instances.values()
+            for i in instances
             if i.state in (InstanceState.QUEUED, InstanceState.RUNNING)
             # _stop records intent immediately; the worker thread flips
             # state to ABORTED asynchronously, so state alone would
             # resurrect deliberately-stopped streams on restart.
             and not i._stop.is_set()
         ]
-        self._state_file.parent.mkdir(parents=True, exist_ok=True)
-        self._state_file.write_text(json.dumps(active, indent=2))
+        # Atomic replace under a lock: a finishing stream's on_finish
+        # races a DELETE's persist; interleaved write_text calls would
+        # corrupt the file and poison the next boot's resume().
+        with self._persist_lock:
+            self._state_file.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self._state_file.with_suffix(".tmp")
+            tmp.write_text(json.dumps(active, indent=2))
+            os.replace(tmp, self._state_file)
 
     def resume(self) -> int:
         """Re-start streams recorded by a previous run. Returns count."""
         if self._state_file is None or not self._state_file.exists():
             return 0
-        entries = json.loads(self._state_file.read_text())
+        try:
+            entries = json.loads(self._state_file.read_text())
+        except (OSError, json.JSONDecodeError) as exc:
+            log.warning("stream state file unreadable (%s); skipping resume",
+                        exc)
+            return 0
         n = 0
         for e in entries:
             try:

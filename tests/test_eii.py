@@ -1,0 +1,191 @@
+"""EII-mode tests: configmgr load/watch, msgbus (meta, blob) framing
+over zmq_ipc, and the manager end-to-end in both source modes
+(decoder source → bus out; bus in → bus out)."""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from evam_tpu.config import Settings
+from evam_tpu.eii.configmgr import ConfigMgr
+from evam_tpu.eii.manager import EiiManager
+from evam_tpu.eii.msgbus import MsgBusPublisher, MsgBusSubscriber
+from evam_tpu.engine import EngineHub
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+from evam_tpu.server.registry import PipelineRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def registry(eight_devices):
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    model_registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                                   width_overrides=NARROW)
+    hub = EngineHub(model_registry, plan=build_mesh(), max_batch=16,
+                    deadline_ms=4.0)
+    return PipelineRegistry(settings, hub=hub)
+
+
+class TestConfigMgr:
+    def test_defaults_without_file(self):
+        cfg = ConfigMgr()
+        assert cfg.get_app_config()["source"] == "gstreamer"
+        assert cfg.get_num_publishers() == 1
+        assert cfg.get_publisher_by_index(0)["Type"] == "zmq_tcp"
+
+    def test_file_load_and_watch(self, tmp_path):
+        f = tmp_path / "config.json"
+        f.write_text(json.dumps({
+            "config": {"pipeline": "video_decode/app_dst"},
+            "interfaces": {"Publishers": [], "Subscribers": []},
+        }))
+        cfg = ConfigMgr(f, watch_interval_s=0.1)
+        assert cfg.get_app_config()["pipeline"] == "video_decode/app_dst"
+        seen = []
+        cfg.watch(seen.append)
+        time.sleep(0.3)
+        f.write_text(json.dumps({
+            "config": {"pipeline": "object_detection/person"},
+            "interfaces": {"Publishers": [], "Subscribers": []},
+        }))
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.05)
+        cfg.close()
+        assert seen and seen[0]["config"]["pipeline"] == "object_detection/person"
+
+
+class TestMsgBus:
+    def test_ipc_roundtrip(self, tmp_path):
+        cfg = {"Type": "zmq_ipc", "EndPoint": str(tmp_path / "socks")}
+        pub = MsgBusPublisher(cfg, "cam1")
+        sub = MsgBusSubscriber(cfg, "cam1", recv_timeout_ms=200)
+        time.sleep(0.3)  # late joiner
+        meta = {"width": 4, "height": 2, "gva_meta": []}
+        blob = b"\x00" * 24
+        got = None
+        for _ in range(20):
+            pub.publish(meta, blob)
+            got = sub.recv()
+            if got is not None:
+                break
+        assert got is not None
+        assert got[0]["width"] == 4
+        assert got[1] == blob
+        sub.close()
+        pub.close()
+
+    def test_meta_only(self, tmp_path):
+        cfg = {"Type": "zmq_ipc", "EndPoint": str(tmp_path / "socks")}
+        pub = MsgBusPublisher(cfg, "t2")
+        sub = MsgBusSubscriber(cfg, "t2", recv_timeout_ms=200)
+        time.sleep(0.3)
+        got = None
+        for _ in range(20):
+            pub.publish({"n": 1})
+            got = sub.recv()
+            if got is not None:
+                break
+        assert got == ({"n": 1}, None)
+        sub.close()
+        pub.close()
+
+
+def _mgr_config(tmp_path, app_cfg, publishers=None, subscribers=None):
+    f = tmp_path / "eii_config.json"
+    f.write_text(json.dumps({
+        "config": app_cfg,
+        "interfaces": {
+            "Publishers": publishers or [{
+                "Name": "default", "Type": "zmq_ipc",
+                "EndPoint": str(tmp_path / "socks"),
+                "Topics": ["results"], "AllowedClients": ["*"],
+            }],
+            "Subscribers": subscribers or [],
+        },
+    }))
+    return ConfigMgr(f)
+
+
+class TestManager:
+    def test_decoder_source_publishes_meta_and_frames(self, registry, tmp_path):
+        cfg = _mgr_config(tmp_path, {
+            "source": "gstreamer",
+            "pipeline": "object_detection/person",
+            "source_parameters": {
+                "type": "uri", "uri": "synthetic://96x96@30?count=300",
+            },
+            "publish_frame": True,
+            "encoding": {"type": "jpeg", "level": 90},
+        })
+        sub = MsgBusSubscriber(
+            {"Type": "zmq_ipc", "EndPoint": str(tmp_path / "socks")},
+            "results", recv_timeout_ms=500,
+        )
+        mgr = EiiManager(
+            Settings(pipelines_dir=str(REPO / "pipelines")),
+            cfg_mgr=cfg, registry=registry,
+        )
+        got = None
+        deadline = time.time() + 90
+        while got is None and time.time() < deadline:
+            got = sub.recv()
+        mgr._stop.set()
+        mgr.registry.stop_instance(mgr.instance.id)
+        sub.close()
+        assert got is not None, "no message published on the bus"
+        meta, blob = got
+        assert {"img_handle", "width", "height", "channels",
+                "gva_meta"} <= set(meta)
+        assert meta["encoding_type"] == "jpeg"
+        assert blob is not None and blob[:2] == b"\xff\xd8"
+
+    def test_msgbus_source_roundtrip(self, registry, tmp_path):
+        sock_dir = str(tmp_path / "socks2")
+        cfg = _mgr_config(
+            tmp_path,
+            {
+                "source": "msgbus",
+                "pipeline": "video_decode/app_dst",
+                "publish_frame": False,
+            },
+            publishers=[{
+                "Name": "default", "Type": "zmq_ipc", "EndPoint": sock_dir,
+                "Topics": ["results2"], "AllowedClients": ["*"],
+            }],
+            subscribers=[{
+                "Name": "in", "Type": "zmq_ipc", "EndPoint": sock_dir,
+                "Topics": ["camera1_stream"],
+            }],
+        )
+        mgr = EiiManager(
+            Settings(pipelines_dir=str(REPO / "pipelines")),
+            cfg_mgr=cfg, registry=registry,
+        )
+        feeder = MsgBusPublisher(
+            {"Type": "zmq_ipc", "EndPoint": sock_dir}, "camera1_stream")
+        sub = MsgBusSubscriber(
+            {"Type": "zmq_ipc", "EndPoint": sock_dir}, "results2",
+            recv_timeout_ms=300,
+        )
+        frame = np.full((8, 8, 3), 7, np.uint8)
+        got = None
+        deadline = time.time() + 60
+        while got is None and time.time() < deadline:
+            feeder.publish({"width": 8, "height": 8}, frame.tobytes())
+            got = sub.recv()
+        mgr._stop.set()
+        mgr.registry.stop_instance(mgr.instance.id)
+        feeder.close()
+        sub.close()
+        assert got is not None, "frame did not round-trip through the bus"
+        meta, _ = got
+        assert meta["width"] == 8 and meta["height"] == 8

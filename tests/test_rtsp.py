@@ -1,0 +1,153 @@
+"""RTSP re-streaming tests: RFC 2435 packetization and an end-to-end
+read of the served stream through OpenCV's FFmpeg RTSP client."""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from evam_tpu.publish.rtsp import (
+    FrameRelay,
+    RtspServer,
+    packetize_jpeg,
+    parse_jpeg,
+)
+
+
+def _jpeg(w=64, h=48, seed=0):
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    frame = rng.integers(0, 255, (h, w, 3), np.uint8)
+    ok, buf = cv2.imencode(".jpg", frame, [cv2.IMWRITE_JPEG_QUALITY, 80])
+    assert ok
+    return buf.tobytes()
+
+
+class TestPacketizer:
+    def test_parse_jpeg(self):
+        w, h, qtables, scan = parse_jpeg(_jpeg(64, 48))
+        assert (w, h) == (64, 48)
+        assert qtables and all(len(q) == 64 for q in qtables)
+        assert len(scan) > 100
+
+    def test_fragmentation_and_marker(self):
+        jpeg = _jpeg(320, 240, seed=2)
+        packets, seq = packetize_jpeg(jpeg, 0, 0, 0xABCD)
+        assert seq == len(packets)
+        # last packet carries the RTP marker bit; others don't
+        markers = [(p[1] & 0x80) != 0 for p in packets]
+        assert markers[-1] and not any(markers[:-1])
+        # payload type is JPEG/26 in every packet
+        assert all(p[1] & 0x7F == 26 for p in packets)
+        # first fragment carries the quantization-table header (Q=255)
+        assert packets[0][12 + 5] == 255
+        # fragment offsets are monotonically increasing
+        offs = [
+            (p[13] << 16) | (p[14] << 8) | p[15] for p in packets
+        ]
+        assert offs[0] == 0 and offs == sorted(offs)
+
+    def test_relay_latest_frame_semantics(self):
+        relay = FrameRelay("x")
+        relay.push_jpeg(b"a")
+        relay.push_jpeg(b"b")
+        jpeg, gen = relay.next_frame(0, timeout=0.1)
+        assert jpeg == b"b" and gen == 2
+        jpeg, gen2 = relay.next_frame(gen, timeout=0.05)
+        assert gen2 == gen  # timeout, no new frame
+
+
+class TestServerEndToEnd:
+    def test_cv2_client_reads_stream(self):
+        import cv2
+
+        server = RtspServer(port=0, host="127.0.0.1")
+        server.start()
+        relay = server.mount("teststream")
+
+        stop = threading.Event()
+
+        def feeder():
+            seed = 0
+            while not stop.is_set():
+                rng = np.random.default_rng(seed % 5)
+                frame = rng.integers(0, 255, (48, 64, 3), np.uint8)
+                relay.push_bgr(frame)
+                seed += 1
+                time.sleep(0.03)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            url = f"rtsp://127.0.0.1:{server.port}/teststream"
+            cap = cv2.VideoCapture(url, cv2.CAP_FFMPEG)
+            assert cap.isOpened(), f"ffmpeg could not open {url}"
+            got = 0
+            for _ in range(30):
+                ok, frame = cap.read()
+                if ok and frame is not None:
+                    got += 1
+                    assert frame.shape == (48, 64, 3)
+                    if got >= 3:
+                        break
+            cap.release()
+            assert got >= 3, "no frames decoded from RTSP stream"
+        finally:
+            stop.set()
+            server.stop()
+
+
+class TestWebRtcSignaler:
+    def test_register_play_stream(self):
+        import asyncio
+        import json
+
+        from evam_tpu.publish.webrtc import WebRtcSignaler
+
+        received = {"register": None, "frames": 0}
+        done = threading.Event()
+        port_holder = {}
+
+        async def server_main():
+            import websockets
+
+            async def handler(ws):
+                async for msg in ws:
+                    if isinstance(msg, (bytes, bytearray)):
+                        received["frames"] += 1
+                        if received["frames"] >= 3:
+                            done.set()
+                            return
+                    else:
+                        data = json.loads(msg)
+                        if data["type"] == "register":
+                            received["register"] = data["stream"]
+                            await ws.send(json.dumps(
+                                {"type": "play", "stream": data["stream"]}))
+
+            async with websockets.serve(handler, "127.0.0.1", 0) as server:
+                port_holder["port"] = server.sockets[0].getsockname()[1]
+                port_holder["ready"].set()
+                while not done.is_set():
+                    await asyncio.sleep(0.05)
+
+        port_holder["ready"] = threading.Event()
+        server_thread = threading.Thread(
+            target=lambda: asyncio.run(server_main()), daemon=True)
+        server_thread.start()
+        assert port_holder["ready"].wait(5)
+
+        relay = FrameRelay("cam0")
+        signaler = WebRtcSignaler(
+            f"ws://127.0.0.1:{port_holder['port']}", "cam0", relay)
+        signaler.start()
+        deadline = time.time() + 15
+        while not done.is_set() and time.time() < deadline:
+            relay.push_jpeg(_jpeg(32, 32, seed=int(time.time() * 10) % 7))
+            time.sleep(0.05)
+        signaler.stop()
+        assert received["register"] == "cam0"
+        assert received["frames"] >= 3
