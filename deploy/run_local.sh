@@ -1,0 +1,37 @@
+#!/bin/bash
+# Local/dev runner probing TPU hardware — counterpart of the
+# reference's docker/run.sh hardware probe (GPU/NCS2/HDDL device
+# cgroups, reference docker/run.sh:83-119) for TPU VMs.
+
+set -euo pipefail
+
+MODE="${RUN_MODE:-EVA}"
+PLATFORM=""
+
+probe_tpu() {
+    # TPU VM device nodes: /dev/accel* (v4+/v5) or vfio-bound PCI.
+    if compgen -G "/dev/accel*" > /dev/null; then
+        echo "found TPU device nodes: $(ls /dev/accel* | tr '\n' ' ')"
+        return 0
+    fi
+    if [ -d /dev/vfio ] && compgen -G "/dev/vfio/*" > /dev/null; then
+        echo "found vfio TPU devices"
+        return 0
+    fi
+    return 1
+}
+
+if ! probe_tpu; then
+    echo "no TPU devices found — running on the CPU fake backend" >&2
+    PLATFORM="cpu"
+    export EVAM_PLATFORM=cpu
+    export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+fi
+
+# Build native kernels if the toolchain is present.
+if command -v g++ > /dev/null; then
+    make -C "$(dirname "$0")/../native" >/dev/null 2>&1 || true
+fi
+
+echo "starting evam-tpu (mode=$MODE platform=${PLATFORM:-tpu})"
+exec python -m evam_tpu.cli.main serve --mode "$MODE" "$@"

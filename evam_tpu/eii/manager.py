@@ -72,29 +72,51 @@ class EiiManager:
         self.cfg = cfg_mgr or ConfigMgr(os.environ.get("EVAM_EII_CONFIG"))
         self.registry = registry or PipelineRegistry(settings)
         self._stop = threading.Event()
+        self._ingest_stop = threading.Event()
         self._sub_thread: threading.Thread | None = None
         self.subscriber: MsgBusSubscriber | None = None
         self.app_source: AppSource | None = None
         self.instance = None
+        self.publish_frame = False
+        self.enc_type = None
+        self.enc_level = None
 
-        app_cfg = self.cfg.get_app_config()
-        self.publish_frame = bool(app_cfg.get("publish_frame", False))
-        enc = app_cfg.get("encoding") or {}
-        self.enc_type = enc.get("type")
-        self.enc_level = enc.get("level")
+        self.publisher: MsgBusPublisher | None = None
+        self._pub_cfg_snapshot: str = ""
+        self._build_publisher()
 
-        pub_cfg = self.cfg.get_publisher_by_index(0)
-        topic = pub_cfg.get("Topics", ["evam_tpu"])[0]
-        self.publisher = MsgBusPublisher(pub_cfg, topic)
-
-        self._start_pipeline(app_cfg)
+        self._start_pipeline(self.cfg.get_app_config())
         # Working hot-reload: restart the pipeline when the config
         # store changes.
         self.cfg.watch(self._on_config_update)
 
+    def _build_publisher(self) -> None:
+        """(Re)create the results publisher from the current interface
+        config — hot reload must honor edited Publishers entries too."""
+        import json as _json
+
+        if self.cfg.get_num_publishers() < 1:
+            raise ValueError(
+                "EII config needs at least one interfaces.Publishers entry")
+        pub_cfg = self.cfg.get_publisher_by_index(0)
+        snapshot = _json.dumps(pub_cfg, sort_keys=True)
+        if self.publisher is not None and snapshot == self._pub_cfg_snapshot:
+            return
+        if self.publisher is not None:
+            self.publisher.close()
+        topics = pub_cfg.get("Topics") or ["evam_tpu"]
+        self.publisher = MsgBusPublisher(pub_cfg, topics[0])
+        self._pub_cfg_snapshot = snapshot
+
     # ------------------------------------------------------- pipeline
 
     def _start_pipeline(self, app_cfg: dict[str, Any]) -> None:
+        # Publish-side settings refresh with the pipeline (hot reload
+        # must honor edited publish_frame/encoding too).
+        self.publish_frame = bool(app_cfg.get("publish_frame", False))
+        enc = app_cfg.get("encoding") or {}
+        self.enc_type = enc.get("type")
+        self.enc_level = enc.get("level")
         pipeline = app_cfg.get(
             "pipeline", "object_detection/person_vehicle_bike"
         )
@@ -107,14 +129,20 @@ class EiiManager:
         if app_cfg.get("source") == "msgbus":
             # Frames arrive over the bus instead of a decoder
             # (reference evas/manager.py:77-88 + subscriber.py).
+            if self.cfg.get_num_subscribers() < 1:
+                raise ValueError(
+                    "source=msgbus needs an interfaces.Subscribers entry")
             sub_cfg = self.cfg.get_subscriber_by_index(0)
-            sub_topic = sub_cfg.get("Topics", ["camera1_stream"])[0]
+            sub_topic = (sub_cfg.get("Topics") or ["camera1_stream"])[0]
+            self._ingest_stop = threading.Event()
             self.subscriber = MsgBusSubscriber(sub_cfg, sub_topic)
             self.app_source = AppSource(maxsize=64)
             source_obj = self.app_source
             request["source"] = {"type": "application"}
             self._sub_thread = threading.Thread(
-                target=self._ingest_loop, name="msgbus-ingest", daemon=True
+                target=self._ingest_loop,
+                args=(self._ingest_stop, self.subscriber, self.app_source),
+                name="msgbus-ingest", daemon=True,
             )
             self._sub_thread.start()
         # Pipelines without a metapublish stage (appsink-terminated,
@@ -126,19 +154,39 @@ class EiiManager:
         has_publish = spec is not None and any(
             s.kind == StageKind.PUBLISH for s in spec.stages
         )
-        self.instance = self.registry.start_instance(
-            name, version, request,
-            publish_fn=self._publish, source=source_obj,
-            sink_fn=None if has_publish else self._publish,
-        )
+        try:
+            self.instance = self.registry.start_instance(
+                name, version, request,
+                publish_fn=self._publish, source=source_obj,
+                sink_fn=None if has_publish else self._publish,
+            )
+        except Exception:
+            # A failed (re)start must not orphan the just-started
+            # ingest thread / ZMQ subscription.
+            self._teardown_ingest()
+            raise
         log.info("EII pipeline %s started (instance %s)",
                  pipeline, self.instance.id[:8])
+
+    def _teardown_ingest(self) -> None:
+        """Stop the current subscriber/ingest thread so a restart never
+        stacks leaked threads or stale ZMQ subscriptions."""
+        self._ingest_stop.set()
+        if self._sub_thread is not None:
+            self._sub_thread.join(timeout=5)
+            self._sub_thread = None
+        if self.subscriber is not None:
+            self.subscriber.close()
+            self.subscriber = None
+        self.app_source = None
 
     def _on_config_update(self, data: dict[str, Any]) -> None:
         log.info("config changed: restarting pipeline")
         if self.instance is not None:
             self.registry.stop_instance(self.instance.id)
             self.instance.wait(timeout=10)
+        self._teardown_ingest()
+        self._build_publisher()
         self._start_pipeline(self.cfg.get_app_config())
 
     # -------------------------------------------------------- publish
@@ -173,10 +221,14 @@ class EiiManager:
 
     # --------------------------------------------------------- ingest
 
-    def _ingest_loop(self) -> None:
-        assert self.subscriber is not None and self.app_source is not None
-        while not self._stop.is_set():
-            msg = self.subscriber.recv()
+    def _ingest_loop(
+        self,
+        stop: threading.Event,
+        subscriber: MsgBusSubscriber,
+        app_source: AppSource,
+    ) -> None:
+        while not self._stop.is_set() and not stop.is_set():
+            msg = subscriber.recv()
             if msg is None:
                 continue
             meta, blob = msg
@@ -193,7 +245,7 @@ class EiiManager:
                     )
                 else:
                     frame = np.frombuffer(blob, np.uint8).reshape(h, w, 3)
-                self.app_source.push(frame)
+                app_source.push(frame)
             except Exception as exc:  # noqa: BLE001 — bad frame, keep going
                 log.warning("msgbus ingest: dropped bad frame (%s)", exc)
                 metrics.inc("evam_eii_ingest_drops")
@@ -215,8 +267,7 @@ class EiiManager:
         self._stop.set()
         if self.app_source is not None:
             self.app_source.end()
-        if self.subscriber is not None:
-            self.subscriber.close()
+        self._teardown_ingest()
         self.cfg.close()
         self.registry.stop_all()
         self.publisher.close()

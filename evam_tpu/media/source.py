@@ -190,11 +190,23 @@ class AppSource:
         self._seq = 0
 
     def push(self, frame: np.ndarray, pts_ns: int | None = None) -> None:
+        """Never blocks: when the consumer stalls (or died), the oldest
+        queued frame is dropped — live-stream semantics, and it keeps
+        feeder threads (msgbus ingest) and shutdown deadlock-free."""
         if self._closed:
             raise RuntimeError("source closed")
         if pts_ns is None:
             pts_ns = time.monotonic_ns()
-        self._queue.put(FrameEvent(frame=frame, pts_ns=pts_ns, seq=self._seq))
+        ev = FrameEvent(frame=frame, pts_ns=pts_ns, seq=self._seq)
+        while True:
+            try:
+                self._queue.put_nowait(ev)
+                break
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
         self._seq += 1
 
     def push_raw(self, data: bytes, width: int, height: int,
@@ -204,7 +216,15 @@ class AppSource:
 
     def end(self) -> None:
         self._closed = True
-        self._queue.put(None)
+        while True:
+            try:
+                self._queue.put_nowait(None)
+                return
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
 
     def frames(self) -> Iterator[FrameEvent]:
         while True:

@@ -55,6 +55,10 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attention_fn: Callable | None = None
     mlp_constraint: Callable | None = None
+    #: > 0 swaps the dense MLP for a mixture-of-experts MLP
+    #: (evam_tpu.parallel.moe — expert-parallel capacity scaling)
+    moe_experts: int = 0
+    moe_constraint: Callable | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -67,11 +71,21 @@ class TransformerBlock(nn.Module):
         )(h, h)
         x = x + h
         h = nn.LayerNorm()(x)
-        h = nn.Dense(self.dim * self.mlp_ratio)(h)
-        if self.mlp_constraint is not None:
-            h = self.mlp_constraint(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.dim)(h)
+        if self.moe_experts > 0:
+            from evam_tpu.parallel.moe import MoeMlp
+
+            h = MoeMlp(
+                self.dim,
+                num_experts=self.moe_experts,
+                mlp_ratio=self.mlp_ratio,
+                expert_constraint=self.moe_constraint,
+            )(h)
+        else:
+            h = nn.Dense(self.dim * self.mlp_ratio)(h)
+            if self.mlp_constraint is not None:
+                h = self.mlp_constraint(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.dim)(h)
         return x + h
 
 
@@ -85,6 +99,8 @@ class ActionDecoder(nn.Module):
     heads: int = 8
     attention_fn: Callable | None = None
     mlp_constraint: Callable | None = None
+    moe_experts: int = 0
+    moe_constraint: Callable | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -99,6 +115,8 @@ class ActionDecoder(nn.Module):
                 self.heads,
                 attention_fn=self.attention_fn,
                 mlp_constraint=self.mlp_constraint,
+                moe_experts=self.moe_experts,
+                moe_constraint=self.moe_constraint,
             )(x)
         x = nn.LayerNorm()(x)
         x = x.mean(axis=1)

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native media kernels (native/evam_media.cpp).
+
+The runtime around the TPU compute path is native where the
+reference's is (its decode/convert chain is C++ GStreamer elements):
+fused resize+BGR→I420, plain conversions, and batch gather run in an
+OpenMP shared library with the GIL released — decode workers scale
+across cores. Falls back to cv2/numpy transparently when the library
+is absent (hermetic CI); builds on demand with `make -C native` or
+`python -m evam_tpu.native`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from evam_tpu.obs import get_logger
+
+log = get_logger("native")
+
+_REPO = Path(__file__).resolve().parent.parent
+_LIB_PATHS = [
+    _REPO / "native" / "libevam_media.so",
+    Path(os.environ.get("EVAM_NATIVE_LIB", "")),
+]
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("EVAM_NO_NATIVE"):
+        return None
+    for p in _LIB_PATHS:
+        if p and p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+                lib.resize_bgr_to_i420.argtypes = [
+                    _U8P, ctypes.c_int, ctypes.c_int,
+                    _U8P, ctypes.c_int, ctypes.c_int,
+                ]
+                lib.bgr_to_i420.argtypes = [
+                    _U8P, _U8P, ctypes.c_int, ctypes.c_int]
+                lib.resize_bgr.argtypes = [
+                    _U8P, ctypes.c_int, ctypes.c_int,
+                    _U8P, ctypes.c_int, ctypes.c_int,
+                ]
+                lib.evam_native_version.restype = ctypes.c_int
+                _lib = lib
+                log.info("native media kernels loaded (%s, v%d)",
+                         p, lib.evam_native_version())
+                return _lib
+            except OSError as exc:
+                log.warning("native lib %s failed to load: %s", p, exc)
+    return None
+
+
+def build(quiet: bool = False) -> bool:
+    """Compile the shared library in-tree (g++ is in the image)."""
+    try:
+        subprocess.run(
+            ["make", "-C", str(_REPO / "native")],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+        log.warning("native build failed: %s", exc)
+        return False
+    global _tried
+    _tried = False
+    return _load() is not None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _use_native() -> bool:
+    """Policy: the OpenMP kernels win on multi-core hosts (rows
+    parallelize; cv2's cvtColor path doesn't), lose to cv2's SIMD on
+    a single core (measured ~1.9ms vs ~1.0ms at 1080p→512²).
+    EVAM_NATIVE=1 forces on, EVAM_NO_NATIVE disables entirely."""
+    if _load() is None:
+        return False
+    if os.environ.get("EVAM_NATIVE"):
+        return True
+    return (os.cpu_count() or 1) >= 4
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_U8P)
+
+
+# ------------------------------------------------------------- kernels
+
+def resize_bgr_to_i420(frame: np.ndarray, dh: int, dw: int) -> np.ndarray:
+    """Fused resize + I420 wire encode (one pass; the hot per-frame
+    host op). Falls back to cv2 resize + cvtColor."""
+    if _use_native() and frame.flags.c_contiguous:
+        lib = _load()
+        sh, sw = frame.shape[:2]
+        out = np.empty((dh * 3 // 2, dw), np.uint8)
+        lib.resize_bgr_to_i420(_ptr(frame), sh, sw, _ptr(out), dh, dw)
+        return out
+    import cv2
+
+    resized = (
+        frame
+        if frame.shape[:2] == (dh, dw)
+        else cv2.resize(frame, (dw, dh), interpolation=cv2.INTER_LINEAR)
+    )
+    return cv2.cvtColor(resized, cv2.COLOR_BGR2YUV_I420)
+
+
+def bgr_to_i420(frame: np.ndarray) -> np.ndarray:
+    if _use_native() and frame.flags.c_contiguous:
+        lib = _load()
+        h, w = frame.shape[:2]
+        out = np.empty((h * 3 // 2, w), np.uint8)
+        lib.bgr_to_i420(_ptr(frame), _ptr(out), h, w)
+        return out
+    import cv2
+
+    return cv2.cvtColor(frame, cv2.COLOR_BGR2YUV_I420)
+
+
+def resize_bgr(frame: np.ndarray, dh: int, dw: int) -> np.ndarray:
+    if _use_native() and frame.flags.c_contiguous:
+        lib = _load()
+        sh, sw = frame.shape[:2]
+        out = np.empty((dh, dw, 3), np.uint8)
+        lib.resize_bgr(_ptr(frame), sh, sw, _ptr(out), dh, dw)
+        return out
+    import cv2
+
+    return cv2.resize(frame, (dw, dh), interpolation=cv2.INTER_LINEAR)
+
+
+if __name__ == "__main__":
+    ok = build()
+    print("native build:", "ok" if ok else "FAILED (fallback active)")
+    raise SystemExit(0 if ok else 1)

@@ -1,0 +1,50 @@
+"""Mixture-of-experts MLP with expert parallelism.
+
+No reference counterpart (the reference's models are small dense
+CNNs); this is forward-looking capacity scaling for the temporal
+decoder: the transformer MLP becomes E experts with a learned router,
+expert weights sharded over a mesh axis so each device holds E/n
+experts (expert parallelism — here sharing the tensor-parallel
+``model`` axis, the common EP=TP-group layout).
+
+Dispatch is dense (every expert evaluated, outputs weighted by the
+router's softmax gate): at zoo scale the expert dimension is small and
+dense dispatch keeps everything static-shaped for XLA — no capacity
+buckets, no token dropping, and the expert-sharded einsum partitions
+cleanly with a single reduce over the expert axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MoeMlp(nn.Module):
+    dim: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    #: sharding constraint applied to the per-expert hidden activation
+    #: [B, T, E, H] (expert axis over the mesh's model axis)
+    expert_constraint: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        e, d, h = self.num_experts, self.dim, self.dim * self.mlp_ratio
+        gates = nn.softmax(nn.Dense(e, name="router")(x), axis=-1)  # [B,T,E]
+        w_up = self.param(
+            "experts_up", nn.initializers.lecun_normal(), (e, d, h))
+        b_up = self.param("experts_up_bias", nn.initializers.zeros, (e, h))
+        w_dn = self.param(
+            "experts_down", nn.initializers.lecun_normal(), (e, h, d))
+        b_dn = self.param("experts_down_bias", nn.initializers.zeros, (e, d))
+        hidden = jnp.einsum("btd,edh->bteh", x, w_up) + b_up
+        if self.expert_constraint is not None:
+            hidden = self.expert_constraint(hidden)
+        hidden = nn.gelu(hidden)
+        out = jnp.einsum("bteh,ehd->bted", hidden, w_dn) + b_dn
+        # Router-weighted combine reduces the expert axis — XLA emits
+        # the cross-device psum when experts are sharded.
+        return jnp.einsum("bted,bte->btd", out, gates)

@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style microbatch rotation over a
+``pipe`` mesh axis.
+
+No reference counterpart (SURVEY.md §2d — the reference has no model
+parallelism at all); this is the layer-sharding axis for decoders too
+deep for one device. Stage s holds layer-stack slice s (params stacked
+on a leading stage axis, sharded over ``pipe``); microbatches enter at
+stage 0, activations hop stage→stage via `lax.ppermute` (one ICI hop
+per step), and after S + M - 1 steps every microbatch has crossed all
+stages. Fill/drain bubbles are masked, outputs psum-gathered from the
+last stage. Differentiable end-to-end — the same loop trains.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def stack_stage_params(param_list):
+    """[per-stage param trees] → one tree with a leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *param_list
+    )
+
+
+def pipeline_apply(
+    apply_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    microbatches: int | None = None,
+) -> jax.Array:
+    """Run x through S pipelined stages.
+
+    apply_fn(stage_params, h) -> h applies ONE stage (shape-preserving).
+    stacked_params: trees with leading stage axis of size S =
+    mesh.shape[pipe_axis]. x: [M, mb, ...] pre-split microbatches
+    (M defaults to S). Returns [M, mb, ...] outputs.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    m = x.shape[0] if microbatches is None else microbatches
+    if x.shape[0] != m:
+        raise ValueError(f"x leading dim {x.shape[0]} != microbatches {m}")
+
+    def kernel(params, xs):
+        # local: params leading axis 1 (this stage), xs [M, mb, ...]
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], params)
+        my = jax.lax.axis_index(pipe_axis)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        # Zero accumulators derived from a device-varying scalar so the
+        # scan carry satisfies shard_map's varying-manual-axes typing.
+        vary0 = (my * 0).astype(xs.dtype)
+        buf = jnp.zeros_like(xs[0]) + vary0
+        outs = jnp.zeros_like(xs) + vary0
+
+        def step(carry, t):
+            buf, outs = carry
+            # Stage 0 ingests microbatch t (clamped); later stages take
+            # the neighbor's activation from the previous step.
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+            )
+            inp = jnp.where(my == 0, x_t, buf)
+            out = apply_fn(stage_params, inp)
+            # Last stage completed microbatch t - (S - 1) this step;
+            # predicated write keeps branch types uniform.
+            done_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            valid = (my == n_stages - 1) & (t >= n_stages - 1)
+            cur = jax.lax.dynamic_index_in_dim(
+                outs, done_idx, axis=0, keepdims=False
+            )
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, out, cur), done_idx, axis=0
+            )
+            buf = jax.lax.ppermute(out, pipe_axis, perm)
+            return (buf, outs), None
+
+        (buf, outs), _ = jax.lax.scan(
+            step, (buf, outs), jnp.arange(m + n_stages - 1)
+        )
+        # Only the last stage holds real outputs; psum replicates them.
+        return jax.lax.psum(
+            jnp.where(my == n_stages - 1, outs, jnp.zeros_like(outs)),
+            pipe_axis,
+        )
+
+    in_param_spec = jax.tree_util.tree_map(
+        lambda _: P(pipe_axis), stacked_params
+    )
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(in_param_spec, P()),
+        out_specs=P(),
+    )(stacked_params, x)
+
+
+def build_pipe_mesh(devices=None, n_stages: int | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_stages or len(devices)
+    return Mesh(np.asarray(devices[:n]).reshape(n), ("pipe",))

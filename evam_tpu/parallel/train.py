@@ -75,6 +75,12 @@ def param_spec(path, leaf) -> P:
     """Megatron-style placement for the decoder transformer; encoder
     convs and small heads replicate."""
     name = _path_str(path)
+    if "MoeMlp" in name and "experts" in name:
+        # Expert axis (leading dim) shards over the model axis —
+        # expert parallelism shares the tp hardware axis.
+        return P("model")
+    if "MoeMlp" in name:  # router
+        return P()
     if "MultiHeadDotProductAttention" in name:
         # qkv kernels [D, H, Dh]; out kernel [H, Dh, D]; biases follow.
         if "/out/" in name:
@@ -113,6 +119,9 @@ class ActionTrainConfig:
     learning_rate: float = 3e-4
     weight_decay: float = 1e-4
     remat_encoder: bool = True
+    #: > 0 enables the mixture-of-experts decoder MLP (expert
+    #: parallelism over the model axis, evam_tpu.parallel.moe)
+    moe_experts: int = 0
 
 
 @dataclasses.dataclass
@@ -166,6 +175,10 @@ def build_action_trainer(
     attention_fn = make_flax_attention_fn(
         mesh, seq_axis="seq", batch_axis="data", head_axis="model"
     )
+    moe_constraint = functools.partial(
+        jax.lax.with_sharding_constraint,
+        shardings=NamedSharding(mesh, P("data", "seq", "model", None)),
+    )
     encoder = ActionEncoder(embed_dim=cfg.embed_dim, width=cfg.encoder_width)
     decoder = ActionDecoder(
         num_classes=cfg.num_classes,
@@ -174,6 +187,8 @@ def build_action_trainer(
         heads=cfg.heads,
         attention_fn=attention_fn,
         mlp_constraint=mlp_constraint,
+        moe_experts=cfg.moe_experts,
+        moe_constraint=moe_constraint if cfg.moe_experts else None,
     )
     tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
 

@@ -70,6 +70,11 @@ def parse_jpeg(data: bytes):
 def packetize_jpeg(jpeg: bytes, seq: int, timestamp: int, ssrc: int):
     """RFC 2435 packets for one frame. Returns (packets, next_seq)."""
     width, height, qtables, scan = parse_jpeg(jpeg)
+    if width > FrameRelay.MAX_DIM or height > FrameRelay.MAX_DIM:
+        raise ValueError(
+            f"RFC 2435 caps dimensions at {FrameRelay.MAX_DIM}; got "
+            f"{width}x{height} (downscale before push)"
+        )
     qdata = b"".join(qtables)
     packets = []
     offset = 0
@@ -112,11 +117,30 @@ class FrameRelay:
     client threads block for the next one (slow clients skip frames —
     live semantics, never backpressure into the pipeline)."""
 
+    #: RFC 2435 encodes dimensions as blocks/8 in one byte → 2040 max.
+    MAX_DIM = 2040
+
     def __init__(self, path: str):
         self.path = path
         self._cond = threading.Condition()
         self._jpeg: bytes | None = None
         self._gen = 0
+        self._clients = 0
+
+    def add_client(self) -> None:
+        with self._cond:
+            self._clients += 1
+
+    def remove_client(self) -> None:
+        with self._cond:
+            self._clients = max(0, self._clients - 1)
+
+    @property
+    def has_clients(self) -> bool:
+        """Producers check this to skip annotate/encode work when
+        nobody is watching (64 streams x 1080p encode for zero viewers
+        is real CPU)."""
+        return self._clients > 0
 
     def push_jpeg(self, jpeg: bytes) -> None:
         with self._cond:
@@ -127,6 +151,15 @@ class FrameRelay:
     def push_bgr(self, frame_bgr: np.ndarray, quality: int = 80) -> None:
         import cv2
 
+        h, w = frame_bgr.shape[:2]
+        # The RFC 2435 header carries dims as blocks-of-8: cap at
+        # MAX_DIM and round to multiples of 8 so the advertised size
+        # matches the JPEG MCU grid exactly.
+        scale = min(1.0, self.MAX_DIM / max(h, w))
+        dh = max(8, int(h * scale) & ~7)
+        dw = max(8, int(w * scale) & ~7)
+        if (dh, dw) != (h, w):
+            frame_bgr = cv2.resize(frame_bgr, (dw, dh))
         ok, buf = cv2.imencode(
             ".jpg", frame_bgr, [cv2.IMWRITE_JPEG_QUALITY, quality]
         )
@@ -271,15 +304,20 @@ class RtspServer:
         ssrc = 0x45564154  # "EVAT"
         gen = 0
         t0 = time.monotonic()
-        while not self._stop.is_set():
-            jpeg, gen = relay.next_frame(gen)
-            if jpeg is None:
-                continue
-            ts = int((time.monotonic() - t0) * RTP_CLOCK)
-            packets, seq = packetize_jpeg(jpeg, seq, ts, ssrc)
-            try:
-                for pkt in packets:
-                    # interleaved framing: '$', channel 0, length
-                    conn.sendall(b"$\x00" + struct.pack(">H", len(pkt)) + pkt)
-            except OSError:
-                return
+        relay.add_client()
+        try:
+            while not self._stop.is_set():
+                jpeg, gen = relay.next_frame(gen)
+                if jpeg is None:
+                    continue
+                ts = int((time.monotonic() - t0) * RTP_CLOCK)
+                packets, seq = packetize_jpeg(jpeg, seq, ts, ssrc)
+                try:
+                    for pkt in packets:
+                        # interleaved framing: '$', channel 0, length
+                        conn.sendall(
+                            b"$\x00" + struct.pack(">H", len(pkt)) + pkt)
+                except OSError:
+                    return
+        finally:
+            relay.remove_client()

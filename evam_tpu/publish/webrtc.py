@@ -64,24 +64,30 @@ class WebRtcSignaler:
                              self.stream, self.server_url)
                     playing = False
                     gen = 0
-                    while not self._stop.is_set():
+                    try:
+                        while not self._stop.is_set():
+                            if playing:
+                                jpeg, gen = await asyncio.to_thread(
+                                    self.relay.next_frame, gen, 0.5)
+                                if jpeg is not None:
+                                    await ws.send(jpeg)
+                                msg = await self._poll(ws)
+                            else:
+                                msg = await self._poll(ws, timeout=0.5)
+                            if msg is None:
+                                continue
+                            data = json.loads(msg)
+                            if data.get("stream") not in (None, self.stream):
+                                continue
+                            if data.get("type") == "play" and not playing:
+                                playing = True
+                                self.relay.add_client()
+                            elif data.get("type") == "stop" and playing:
+                                playing = False
+                                self.relay.remove_client()
+                    finally:
                         if playing:
-                            jpeg, gen = await asyncio.to_thread(
-                                self.relay.next_frame, gen, 0.5)
-                            if jpeg is not None:
-                                await ws.send(jpeg)
-                            msg = await self._poll(ws)
-                        else:
-                            msg = await self._poll(ws, timeout=0.5)
-                        if msg is None:
-                            continue
-                        data = json.loads(msg)
-                        if data.get("stream") not in (None, self.stream):
-                            continue
-                        if data.get("type") == "play":
-                            playing = True
-                        elif data.get("type") == "stop":
-                            playing = False
+                            self.relay.remove_client()
             except Exception as exc:  # noqa: BLE001 — reconnect loop
                 if self._stop.is_set():
                     return

@@ -129,16 +129,18 @@ class PipelineRegistry:
             stages=[],
             request=request,
             destination=destination,
-            on_finish=lambda _inst: self._persist(),
+            on_finish=lambda _inst: self._on_instance_finish(cleanup_fns),
             source=source,
         )
         meta_fn = publish_fn or (lambda ctx: destination.publish(ctx.metadata))
         frame_cfg = (request.get("destination") or {}).get("frame") or {}
         relay = None
+        cleanup_fns: list = []
         if frame_cfg.get("type") == "rtsp" and self.rtsp is not None:
             # Annotated re-stream at rtsp://host:8554/<path> (reference
             # destination.frame contract + ENABLE_RTSP flow).
             relay = self.rtsp.mount(frame_cfg.get("path") or name)
+            cleanup_fns.append(lambda: self.rtsp.unmount(relay.path))
         elif (frame_cfg.get("type") == "webrtc"
               and self.settings.enable_webrtc
               and self.settings.webrtc_signaling_server):
@@ -149,10 +151,12 @@ class PipelineRegistry:
             from evam_tpu.publish.webrtc import WebRtcSignaler
 
             relay = FrameRelay(frame_cfg.get("peer-id") or name)
-            WebRtcSignaler(
+            signaler = WebRtcSignaler(
                 self.settings.webrtc_signaling_server,
                 relay.path, relay,
-            ).start()
+            )
+            signaler.start()
+            cleanup_fns.append(signaler.stop)
         if relay is not None:
             from evam_tpu.publish.annotate import annotate_frame
 
@@ -160,7 +164,9 @@ class PipelineRegistry:
 
             def meta_fn(ctx, _base=base_fn, _relay=relay):  # noqa: F811
                 _base(ctx)
-                if ctx.frame is not None:
+                # annotate+encode only when someone is actually
+                # watching — it's full-frame host CPU per frame.
+                if ctx.frame is not None and _relay.has_clients:
                     _relay.push_bgr(annotate_frame(ctx))
 
         try:
@@ -172,7 +178,14 @@ class PipelineRegistry:
                 sink_fn=sink_fn,
             )
         except Exception:
-            destination.close()  # already-opened file/socket must not leak
+            # Already-acquired resources must not leak on a failed
+            # start: file/socket destination, RTSP mount, signaler.
+            destination.close()
+            for fn in cleanup_fns:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001
+                    pass
             raise
         instance.stages = stages
         with self._lock:
@@ -181,6 +194,14 @@ class PipelineRegistry:
         log.info("started %s/%s instance %s", name, version, instance.id)
         self._persist()
         return instance
+
+    def _on_instance_finish(self, cleanup_fns: list) -> None:
+        for fn in cleanup_fns:
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001
+                log.warning("frame-destination cleanup failed: %s", exc)
+        self._persist()
 
     def get_instance(self, instance_id: str) -> StreamInstance | None:
         return self.instances.get(instance_id)

@@ -46,18 +46,33 @@ def _resize_for_engine(frame: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     h, w = size
     if frame.shape[0] == h and frame.shape[1] == w:
         return frame
-    import cv2
+    from evam_tpu import native
 
-    return cv2.resize(frame, (w, h), interpolation=cv2.INTER_LINEAR)
+    return native.resize_bgr(frame, h, w)
 
 
 def _encode_wire(frame_bgr: np.ndarray, wire_format: str) -> np.ndarray:
     """Host-side wire encoding (decode-thread side of ops.color)."""
     if wire_format == "i420":
-        from evam_tpu.ops.color import bgr_to_i420_host
+        from evam_tpu import native
 
-        return bgr_to_i420_host(frame_bgr)
+        return native.bgr_to_i420(frame_bgr)
     return np.ascontiguousarray(frame_bgr)
+
+
+def _wire_frame(
+    frame: np.ndarray, size: tuple[int, int], wire_format: str
+) -> np.ndarray:
+    """Fused resize + wire encode — ONE pass over the pixels in the
+    native kernel (native/evam_media.cpp) instead of a resize pass
+    plus a convert pass; this is the per-frame host hot op at high
+    stream counts. native.resize_bgr_to_i420 owns the
+    native-vs-cv2 policy and fallback."""
+    if wire_format == "i420":
+        from evam_tpu import native
+
+        return native.resize_bgr_to_i420(frame, size[0], size[1])
+    return _encode_wire(_resize_for_engine(frame, size), wire_format)
 
 
 class DetectStage(AsyncStage):
@@ -94,8 +109,8 @@ class DetectStage(AsyncStage):
         self._count += 1
         if (self._count - 1) % self.interval:
             return None  # inference-interval skip: reuse last regions
-        frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.engine.submit(frames=_encode_wire(frame, self.wire))
+        return self.engine.submit(
+            frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -176,8 +191,9 @@ class ClassifyStage(AsyncStage):
         boxes = np.zeros((self.ROI_BUDGET, 4), np.float32)
         for i, r in enumerate(regions):
             boxes[i] = [r.x0, r.y0, r.x1, r.y1]
-        frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.engine.submit(frames=_encode_wire(frame, self.wire), boxes=boxes)
+        return self.engine.submit(
+            frames=_wire_frame(ctx.frame, self.ingest_size, self.wire),
+            boxes=boxes)
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -230,8 +246,8 @@ class ActionStage(AsyncStage):
         self.wire = hub.wire_format
 
     def submit(self, ctx: FrameContext) -> Future | None:
-        frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.enc_engine.submit(frames=_encode_wire(frame, self.wire))
+        return self.enc_engine.submit(
+            frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -364,8 +380,8 @@ class FusedDetectClassifyStage(AsyncStage):
         self._count += 1
         if (self._count - 1) % self.interval:
             return None
-        frame = _resize_for_engine(ctx.frame, self.ingest_size)
-        return self.engine.submit(frames=_encode_wire(frame, self.wire))
+        return self.engine.submit(
+            frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:

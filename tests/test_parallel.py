@@ -109,3 +109,89 @@ class TestActionTrainer:
         assert qkv.sharding.spec == jax.sharding.PartitionSpec(
             None, "model", None
         )
+
+
+class TestPipelineParallel:
+    def test_matches_sequential(self, eight_devices):
+        import jax.numpy as jnp
+
+        from evam_tpu.models.zoo.action import TransformerBlock
+        from evam_tpu.parallel.pipeline import (
+            build_pipe_mesh,
+            pipeline_apply,
+            stack_stage_params,
+        )
+
+        mesh = build_pipe_mesh(devices=eight_devices, n_stages=4)
+        block = TransformerBlock(dim=32, heads=2)
+        x0 = jnp.zeros((2, 8, 32))
+        params = [
+            block.init(k, x0)["params"]
+            for k in jax.random.split(jax.random.PRNGKey(0), 4)
+        ]
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8, 32))
+
+        def apply_fn(p, h):
+            return block.apply({"params": p}, h)
+
+        out = pipeline_apply(apply_fn, stack_stage_params(params), x, mesh)
+        ref = x
+        for p in params:
+            ref = jax.vmap(lambda mb, _p=p: block.apply({"params": _p}, mb))(ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_grads_flow(self, eight_devices):
+        import jax.numpy as jnp
+
+        from evam_tpu.models.zoo.action import TransformerBlock
+        from evam_tpu.parallel.pipeline import (
+            build_pipe_mesh,
+            pipeline_apply,
+            stack_stage_params,
+        )
+
+        mesh = build_pipe_mesh(devices=eight_devices, n_stages=2)
+        block = TransformerBlock(dim=16, heads=2)
+        x0 = jnp.zeros((2, 4, 16))
+        params = [
+            block.init(k, x0)["params"]
+            for k in jax.random.split(jax.random.PRNGKey(2), 2)
+        ]
+        stacked = stack_stage_params(params)
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 4, 16))
+
+        def loss(sp):
+            return pipeline_apply(
+                lambda p, h: block.apply({"params": p}, h), sp, x, mesh
+            ).sum()
+
+        g = jax.grad(loss)(stacked)
+        total = jax.tree_util.tree_reduce(
+            lambda a, b: a + float(jnp.abs(b).sum()), g, 0.0)
+        assert total > 0
+
+
+class TestMoE:
+    def test_moe_trainer_step(self, mesh222):
+        cfg = ActionTrainConfig(
+            num_classes=8, embed_dim=32, depth=1, heads=2,
+            encoder_width=4, frame_size=(32, 32), clip_len=4,
+            moe_experts=4, learning_rate=1e-2,
+        )
+        tr = build_action_trainer(mesh222, cfg)
+        state = tr.init_state(0)
+        # expert params exist and shard over the model axis
+        moe = state["params"]["dec"]["TransformerBlock_0"]["MoeMlp_0"]
+        assert moe["experts_up"].shape[0] == 4
+        assert moe["experts_up"].sharding.spec == jax.sharding.PartitionSpec(
+            "model")
+        rng = np.random.default_rng(0)
+        clips = rng.integers(0, 255, (4, 4, 32, 32, 3), np.uint8)
+        labels = rng.integers(0, 8, (4,)).astype(np.int32)
+        c, l = tr.shard_batch(clips, labels)
+        losses = []
+        for _ in range(3):
+            state, m = tr.train_step(state, c, l)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
