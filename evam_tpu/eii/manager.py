@@ -275,6 +275,9 @@ class EiiManager:
 
 def run_eii_service(settings: Settings) -> int:
     """Blocking entrypoint for ``evam-tpu serve --mode EII``."""
+    from evam_tpu.obs.trace import init_observability
+
+    init_observability(settings)
     manager = EiiManager(settings)
     log.info("EII service running")
     manager.run_forever()

@@ -1,0 +1,86 @@
+"""Fault injection (SURVEY.md §5.3 — the reference has none; its
+recovery story is container restart policy). Enabled only via the
+``EVAM_FAULT_INJECT`` env var, e.g.:
+
+    EVAM_FAULT_INJECT="drop=0.01,stall=0.001,stall_ms=200,corrupt=0.005"
+
+The runner consults this per frame; injected faults exercise the
+per-frame error isolation, reconnect/backoff, and supervision paths
+under test and soak load.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import numpy as np
+
+from evam_tpu.obs import get_logger
+from evam_tpu.obs.metrics import metrics
+
+log = get_logger("obs.faults")
+
+
+class FaultInjector:
+    def __init__(self, spec: str = "", seed: int | None = None):
+        cfg = {}
+        for part in (spec or "").split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                try:
+                    cfg[k.strip()] = float(v)
+                except ValueError:
+                    pass
+        self.drop_p = cfg.get("drop", 0.0)
+        self.stall_p = cfg.get("stall", 0.0)
+        self.stall_ms = cfg.get("stall_ms", 100.0)
+        self.corrupt_p = cfg.get("corrupt", 0.0)
+        self.error_p = cfg.get("error", 0.0)
+        self._rng = random.Random(seed)
+
+    @property
+    def active(self) -> bool:
+        return any(
+            p > 0 for p in (self.drop_p, self.stall_p, self.corrupt_p,
+                            self.error_p)
+        )
+
+    def apply(self, frame: np.ndarray | None):
+        """Returns the (possibly corrupted) frame, or None to drop.
+        May sleep (stall) or raise (error). Drop applies only to video
+        frames (audio events carry frame=None and can't be dropped
+        here), so the drop metric counts real drops only."""
+        if (
+            self.drop_p
+            and frame is not None
+            and self._rng.random() < self.drop_p
+        ):
+            metrics.inc("evam_faults_injected", labels={"kind": "drop"})
+            return None
+        if self.stall_p and self._rng.random() < self.stall_p:
+            metrics.inc("evam_faults_injected", labels={"kind": "stall"})
+            time.sleep(self.stall_ms / 1e3)
+        if self.error_p and self._rng.random() < self.error_p:
+            metrics.inc("evam_faults_injected", labels={"kind": "error"})
+            raise RuntimeError("injected fault (EVAM_FAULT_INJECT error)")
+        if (
+            self.corrupt_p
+            and frame is not None
+            and self._rng.random() < self.corrupt_p
+        ):
+            metrics.inc("evam_faults_injected", labels={"kind": "corrupt"})
+            frame = frame.copy()
+            h = frame.shape[0]
+            frame[self._rng.randrange(h)] = self._rng.randrange(256)
+        return frame
+
+
+def from_env() -> FaultInjector | None:
+    spec = os.environ.get("EVAM_FAULT_INJECT", "")
+    inj = FaultInjector(spec)
+    if inj.active:
+        log.warning("fault injection ACTIVE: %s", spec)
+        return inj
+    return None

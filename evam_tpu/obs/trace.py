@@ -1,0 +1,65 @@
+"""Per-stage tracing + profiler hooks (SURVEY.md §5.1).
+
+The reference exposes only GST_DEBUG levels and a pass-through
+PROFILING_MODE env (eii/docker-compose.yml:43,59). Here: every stage
+execution lands in a labeled latency histogram (visible at /metrics as
+p50/p90/p99), and PROFILING_MODE=true starts the jax.profiler server
+so `tensorboard --logdir` / `jax.profiler.trace` can capture device
+timelines from a running service.
+"""
+
+from __future__ import annotations
+
+from evam_tpu.obs import get_logger
+from evam_tpu.obs.metrics import metrics
+
+log = get_logger("obs.trace")
+
+_PROFILER_PORT = 9999
+_profiler_started = False
+
+
+def stage_timer(stage_name: str):
+    """Record one stage execution into evam_stage_seconds{stage=...}
+    (thin alias over the registry's timing context manager)."""
+    return metrics.time("evam_stage_seconds", labels={"stage": stage_name})
+
+
+def observe_frame_latency(stream_id: str, seconds: float) -> None:
+    """End-to-end per-frame latency (feed → chain complete) — the
+    BASELINE.md p99 target is measured from this histogram."""
+    metrics.observe(
+        "evam_frame_latency_seconds", seconds, labels={"stream": stream_id}
+    )
+
+
+def maybe_start_profiler(enabled: bool, port: int = _PROFILER_PORT) -> bool:
+    """Start the jax.profiler server once when PROFILING_MODE is on."""
+    global _profiler_started
+    if not enabled or _profiler_started:
+        return _profiler_started
+    import jax
+
+    jax.profiler.start_server(port)
+    _profiler_started = True
+    log.info("jax profiler server on :%d (PROFILING_MODE)", port)
+    return True
+
+
+def init_observability(settings) -> None:
+    """One-call runtime bootstrap for both serve entrypoints:
+    compilation cache + optional profiler server."""
+    configure_compilation_cache(settings.tpu.compile_cache_dir)
+    maybe_start_profiler(settings.profiling_mode)
+
+
+def configure_compilation_cache(cache_dir: str) -> None:
+    """Persist XLA executables across restarts (SURVEY.md §5.4 — the
+    reference's analogue is the OpenCL cl_cache, Dockerfile:77-78)."""
+    if not cache_dir:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    log.info("XLA compilation cache at %s", cache_dir)

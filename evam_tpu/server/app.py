@@ -147,6 +147,9 @@ def build_app(
 
 def run_server(settings: Settings) -> int:
     """Blocking entrypoint for ``evam-tpu serve --mode EVA``."""
+    from evam_tpu.obs.trace import init_observability
+
+    init_observability(settings)
     registry = PipelineRegistry(settings)
     app = build_app(registry, stop_registry_on_shutdown=True)
     extras = []

@@ -79,6 +79,8 @@ class FrameContext:
     audio: np.ndarray | None = None
     #: stage cursor used by the runner
     stage_index: int = 0
+    #: wall-clock ingest time (perf_counter) for latency histograms
+    ingest_t: float | None = None
     #: arbitrary cross-stage scratch (e.g. pending futures)
     scratch: dict[str, Any] = field(default_factory=dict)
 

@@ -17,8 +17,12 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Callable, Iterator
 
+import time
+
 from evam_tpu.media.source import FrameEvent
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs.faults import from_env as faults_from_env
+from evam_tpu.obs.trace import observe_frame_latency, stage_timer
 from evam_tpu.stages.base import AsyncStage, Stage
 from evam_tpu.stages.context import FrameContext
 
@@ -51,6 +55,7 @@ class StreamRunner:
         self.errors = 0
         self._parked: deque[_Parked] = deque()
         self._stopped = False
+        self._faults = faults_from_env()
 
     # ----------------------------------------------------------- API
 
@@ -74,7 +79,17 @@ class StreamRunner:
             seq=ev.seq,
             stream_id=self.stream_id,
             source_uri=self.source_uri,
+            ingest_t=time.perf_counter(),
         )
+        if self._faults is not None:
+            try:
+                frame = self._faults.apply(ctx.frame)
+            except Exception as exc:  # noqa: BLE001 — injected error
+                self._handle_error(exc, ctx)
+                return
+            if frame is None and ctx.frame is not None:
+                return  # injected drop
+            ctx.frame = frame
         # Free a slot first (blocking only when the window is full),
         # then start this frame down the chain.
         self.pump(block=len(self._parked) >= self.window)
@@ -96,7 +111,8 @@ class StreamRunner:
             self._parked.popleft()
             try:
                 result = head.future.result() if head.future is not None else None
-                outs = head.stage.complete(head.ctx, result)
+                with stage_timer(f"{head.stage.name}.complete"):
+                    outs = head.stage.complete(head.ctx, result)
             except Exception as exc:  # noqa: BLE001 — frame-level fault isolation
                 self._handle_error(exc, head.ctx)
                 continue
@@ -120,7 +136,8 @@ class StreamRunner:
                 self._parked.append(_Parked(ctx, stage, fut))
                 return
             try:
-                outs = stage.process(ctx)
+                with stage_timer(stage.name):
+                    outs = stage.process(ctx)
             except Exception as exc:  # noqa: BLE001
                 self._handle_error(exc, ctx)
                 return
@@ -130,13 +147,19 @@ class StreamRunner:
                 i += 1
                 continue
             # fan-out (e.g. audio re-chunking): each emitted ctx
-            # continues from the next stage.
+            # continues from the next stage, inheriting the parent's
+            # ingest time so the latency histogram covers them.
             for out in outs:
                 out.stage_index = i + 1
+                if out.ingest_t is None:
+                    out.ingest_t = ctx.ingest_t
                 self._advance(out)
             return
         self.frames_out += 1
         metrics.inc("evam_frames_processed", labels={"stream": self.stream_id})
+        if ctx.ingest_t is not None:
+            observe_frame_latency(
+                self.stream_id, time.perf_counter() - ctx.ingest_t)
 
     def _handle_error(self, exc: Exception, ctx: FrameContext) -> None:
         self.errors += 1

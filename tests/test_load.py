@@ -1,0 +1,119 @@
+"""Load + hardening tests: 16 concurrent streams through ONE shared
+engine (BASELINE.md config 3's shape, scaled down for CI), fault
+injection, stage tracing, frame-latency histograms."""
+
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from evam_tpu.config import Settings
+from evam_tpu.engine import EngineHub
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.obs.faults import FaultInjector
+from evam_tpu.obs.metrics import metrics
+from evam_tpu.parallel import build_mesh
+from evam_tpu.server.registry import PipelineRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+
+@pytest.fixture(scope="module")
+def registry(eight_devices):
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    hub = EngineHub(
+        ModelRegistry(dtype="float32", input_overrides=SMALL,
+                      width_overrides=NARROW),
+        plan=build_mesh(), max_batch=16, deadline_ms=4.0,
+    )
+    reg = PipelineRegistry(settings, hub=hub)
+    yield reg
+    reg.stop_all()
+
+
+class TestMultiStreamLoad:
+    N_STREAMS = 16
+    FRAMES = 20
+
+    def test_16_streams_share_one_engine(self, registry):
+        instances = []
+        for i in range(self.N_STREAMS):
+            inst = registry.start_instance(
+                "object_detection", "person_vehicle_bike",
+                {
+                    "source": {
+                        "uri": f"synthetic://96x96@30?count={self.FRAMES}"
+                               f"&seed={i}",
+                        "type": "uri",
+                    },
+                    "destination": {"metadata": {"type": "null"}},
+                },
+            )
+            instances.append(inst)
+        deadline = time.time() + 180
+        for inst in instances:
+            inst.wait(timeout=max(1, deadline - time.time()))
+        states = [i.state.value for i in instances]
+        assert states.count("COMPLETED") == self.N_STREAMS, states
+        total = sum(i._runner.frames_out for i in instances)
+        assert total == self.N_STREAMS * self.FRAMES
+        # Cross-stream batching actually happened: mean batch occupancy
+        # of the shared detect engine must exceed 1 frame/batch.
+        stats = registry.hub.stats()
+        key = next(k for k in stats if k.startswith("detect:"))
+        assert stats[key]["items"] >= self.N_STREAMS * self.FRAMES * 0.5
+        # frames per batch (occupancy is normalized to max_batch)
+        assert stats[key]["items"] / stats[key]["batches"] > 4.0, stats[key]
+
+    def test_latency_histogram_populated(self, registry):
+        # Self-sufficient: run one tiny stream, then check histograms.
+        inst = registry.start_instance(
+            "video_decode", "app_dst",
+            {
+                "source": {"uri": "synthetic://64x64@30?count=3",
+                           "type": "uri"},
+                "destination": {"metadata": {"type": "null"}},
+            },
+        )
+        inst.wait(timeout=60)
+        text = metrics.render()
+        assert "evam_frame_latency_seconds" in text
+        assert "evam_stage_seconds" in text
+
+
+class TestFaultInjection:
+    def test_drop_and_error_rates(self):
+        inj = FaultInjector("drop=0.5,error=0.0", seed=7)
+        import numpy as np
+
+        frame = np.zeros((8, 8, 3), np.uint8)
+        dropped = sum(inj.apply(frame) is None for _ in range(400))
+        assert 120 < dropped < 280
+
+    def test_error_injection_isolated_per_frame(self, registry, monkeypatch):
+        monkeypatch.setenv("EVAM_FAULT_INJECT", "error=0.3")
+        inst = registry.start_instance(
+            "video_decode", "app_dst",
+            {
+                "source": {"uri": "synthetic://64x64@30?count=30",
+                           "type": "uri"},
+                "destination": {"metadata": {"type": "null"}},
+            },
+        )
+        inst.wait(timeout=120)
+        # injected per-frame errors must not kill the stream
+        assert inst.state.value == "COMPLETED"
+        r = inst._runner
+        assert r.errors > 0
+        assert r.frames_out + r.errors <= 30
+        assert r.frames_out > 0
+
+    def test_inactive_spec_returns_none(self, monkeypatch):
+        from evam_tpu.obs import faults
+
+        monkeypatch.delenv("EVAM_FAULT_INJECT", raising=False)
+        assert faults.from_env() is None
