@@ -38,6 +38,9 @@ class TPUSettings(BaseModel):
     precision: str = "bfloat16"
     donate_buffers: bool = True
     compile_cache_dir: str = ""
+    #: precompile every batch bucket in the background when an engine
+    #: is created (kills mid-traffic compile spikes; off in tests)
+    warmup: bool = True
 
 
 class Settings(BaseModel):
@@ -92,6 +95,7 @@ class Settings(BaseModel):
             "EVAM_BATCH_DEADLINE_MS": ("batch_deadline_ms", float),
             "EVAM_PRECISION": ("precision", str),
             "EVAM_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
+            "EVAM_WARMUP": ("warmup", _parse_bool),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

@@ -110,6 +110,10 @@ class BatchEngine:
 
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._done: queue.Queue[tuple | None] = queue.Queue()
+        self._warm_lock = threading.Lock()
+        self._warming = False
+        #: set when background warmup finishes (or fails)
+        self.warmed = threading.Event()
         self._in_flight = threading.Semaphore(max_in_flight)
         self._stop = threading.Event()
         self._dispatcher = threading.Thread(
@@ -145,6 +149,29 @@ class BatchEngine:
             }
             np.asarray(self._run(batch))
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
+
+    def warm_async(self, **example: np.ndarray) -> None:
+        """Fire-and-forget bucket precompilation (serving path: kills
+        the mid-traffic compile spike when a batch first crosses a
+        bucket boundary). Idempotent."""
+        with self._warm_lock:
+            if self._warming:
+                return
+            self._warming = True
+        self.set_example(**example)
+        threading.Thread(
+            target=self._warm_guarded,
+            name=f"engine-{self.name}-warmup",
+            daemon=True,
+        ).start()
+
+    def _warm_guarded(self) -> None:
+        try:
+            self.warmup()
+        except Exception as exc:  # noqa: BLE001 — warmup must never kill serving
+            log.warning("engine %s warmup failed: %s", self.name, exc)
+        finally:
+            self.warmed.set()
 
     def stop(self) -> None:
         self._stop.set()

@@ -39,7 +39,11 @@ class EngineHub:
         max_batch: int = 32,
         deadline_ms: float = 8.0,
         wire_format: str = "i420",
+        warmup: bool = False,
     ):
+        #: serving sets True: stages precompile every batch bucket in
+        #: the background right after engine creation
+        self.warmup = warmup
         self.registry = registry
         self.plan = plan
         self.max_batch = max_batch

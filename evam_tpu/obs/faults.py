@@ -23,16 +23,28 @@ from evam_tpu.obs.metrics import metrics
 log = get_logger("obs.faults")
 
 
+_KNOWN_KEYS = {"drop", "stall", "stall_ms", "corrupt", "error"}
+
+
 class FaultInjector:
     def __init__(self, spec: str = "", seed: int | None = None):
         cfg = {}
         for part in (spec or "").split(","):
-            if "=" in part:
-                k, v = part.split("=", 1)
-                try:
-                    cfg[k.strip()] = float(v)
-                except ValueError:
-                    pass
+            if not part.strip():
+                continue
+            k, _, v = part.partition("=")
+            k = k.strip()
+            try:
+                value = float(v)
+            except ValueError:
+                log.warning("EVAM_FAULT_INJECT: ignoring malformed entry %r",
+                            part)
+                continue
+            if k not in _KNOWN_KEYS:
+                log.warning("EVAM_FAULT_INJECT: unknown key %r (known: %s)",
+                            k, sorted(_KNOWN_KEYS))
+                continue
+            cfg[k] = value
         self.drop_p = cfg.get("drop", 0.0)
         self.stall_p = cfg.get("stall", 0.0)
         self.stall_ms = cfg.get("stall_ms", 100.0)
@@ -77,10 +89,20 @@ class FaultInjector:
         return frame
 
 
+_cache: tuple[str, FaultInjector | None] | None = None
+
+
 def from_env() -> FaultInjector | None:
+    """Injector for the current EVAM_FAULT_INJECT value, parsed (and
+    its ACTIVE warning logged) once per distinct spec — runners are
+    created per stream and per reconnect attempt."""
+    global _cache
     spec = os.environ.get("EVAM_FAULT_INJECT", "")
+    if _cache is not None and _cache[0] == spec:
+        return _cache[1]
     inj = FaultInjector(spec)
-    if inj.active:
+    result = inj if inj.active else None
+    if result is not None:
         log.warning("fault injection ACTIVE: %s", spec)
-        return inj
-    return None
+    _cache = (spec, result)
+    return result

@@ -27,10 +27,11 @@ def stage_timer(stage_name: str):
 
 def observe_frame_latency(stream_id: str, seconds: float) -> None:
     """End-to-end per-frame latency (feed → chain complete) — the
-    BASELINE.md p99 target is measured from this histogram."""
-    metrics.observe(
-        "evam_frame_latency_seconds", seconds, labels={"stream": stream_id}
-    )
+    BASELINE.md p99 target is measured from this histogram. ONE
+    aggregate histogram, not per-stream: stream ids are per-instance
+    UUIDs and a labeled histogram per dead stream would grow the
+    process-global registry forever."""
+    metrics.observe("evam_frame_latency_seconds", seconds)
 
 
 def maybe_start_profiler(enabled: bool, port: int = _PROFILER_PORT) -> bool:

@@ -51,6 +51,7 @@ class PipelineRegistry:
                 plan=plan,
                 max_batch=settings.tpu.max_batch,
                 deadline_ms=settings.tpu.batch_deadline_ms,
+                warmup=settings.tpu.warmup,
             )
         self.hub = hub
         self.instances: dict[str, StreamInstance] = {}

@@ -75,6 +75,23 @@ def _wire_frame(
     return _encode_wire(_resize_for_engine(frame, size), wire_format)
 
 
+
+def _warm_engine(hub: EngineHub, engine, ingest_size, wire_format,
+                 **extra_example) -> None:
+    """Precompile the engine's batch buckets in the background when the
+    hub serves live traffic (hub.warmup)."""
+    if not hub.warmup:
+        return
+    h, w = ingest_size
+    if wire_format == "i420":
+        from evam_tpu.ops.color import i420_shape
+
+        frame = np.zeros(i420_shape(h, w), np.uint8)
+    else:
+        frame = np.zeros((h, w, 3), np.uint8)
+    engine.warm_async(frames=frame, **extra_example)
+
+
 class DetectStage(AsyncStage):
     """gvadetect counterpart. Properties (reference
     pipelines/object_detection/person_vehicle_bike/pipeline.json:18-40):
@@ -102,6 +119,7 @@ class DetectStage(AsyncStage):
         self.ingest_size = _wire_safe_size(
             (self.model.preprocess.height, self.model.preprocess.width)
         )
+        _warm_engine(hub, self.engine, self.ingest_size, self.wire)
         self._count = 0
         self._last_regions: list[Region] = []
 
@@ -171,6 +189,10 @@ class ClassifyStage(AsyncStage):
         # stackable while preserving enough pixels for small ROIs.
         self.ingest_size = _wire_safe_size(
             tuple(properties.get("ingest-size", (432, 768)))
+        )
+        _warm_engine(
+            hub, self.engine, self.ingest_size, self.wire,
+            boxes=np.zeros((self.ROI_BUDGET, 4), np.float32),
         )
         self._count = 0
 
@@ -244,6 +266,12 @@ class ActionStage(AsyncStage):
         self.clip: deque[np.ndarray] = deque(maxlen=CLIP_LEN)
         self.threshold = float(properties.get("threshold", 0.0))
         self.wire = hub.wire_format
+        _warm_engine(hub, self.enc_engine, self.ingest_size, self.wire)
+        if hub.warmup:
+            embed_dim = getattr(self.enc_model.module, "embed_dim", 512)
+            self.dec_engine.warm_async(
+                clips=np.zeros((CLIP_LEN, embed_dim), np.float32)
+            )
 
     def submit(self, ctx: FrameContext) -> Future | None:
         return self.enc_engine.submit(
@@ -290,6 +318,9 @@ class AudioDetectStage(AsyncStage):
             "audio", model_key, properties.get("model-instance-id")
         )
         self.model = hub.model(model_key)
+        if hub.warmup:
+            self.engine.warm_async(
+                windows=np.zeros(self.WINDOW, np.int16))
         self._buffer = np.zeros(0, np.int16)
         self._since_last = 0
 
@@ -373,6 +404,7 @@ class FusedDetectClassifyStage(AsyncStage):
         self.ingest_size = _wire_safe_size(
             (self.det_model.preprocess.height, self.det_model.preprocess.width)
         )
+        _warm_engine(hub, self.engine, self.ingest_size, self.wire)
         self._count = 0
         self._last_regions: list[Region] = []
 

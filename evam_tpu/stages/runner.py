@@ -118,6 +118,8 @@ class StreamRunner:
                 continue
             for ctx in outs:
                 ctx.stage_index = head.ctx.stage_index + 1
+                if ctx.ingest_t is None:
+                    ctx.ingest_t = head.ctx.ingest_t
                 self._advance(ctx)
             block = False  # only the head wait is blocking
 

@@ -150,3 +150,20 @@ def test_hub_stats(hub):
     det = stats["detect:object_detection/person_vehicle_bike"]
     assert det["items"] >= 25
     assert 0 < det["mean_occupancy"] <= 1.0
+
+
+def test_warm_async_precompiles_buckets(hub):
+    import time
+
+    model = hub.model("object_detection/person")
+    engine = hub.engine("detect", "object_detection/person",
+                        instance_id="warm-test")
+    # hub fixture uses the raw-BGR wire
+    h, w = model.preprocess.height, model.preprocess.width
+    frame = np.zeros((h, w, 3), np.uint8)
+    engine.warm_async(frames=frame)
+    engine.warm_async(frames=frame)  # idempotent: second call no-ops
+    assert engine.warmed.wait(timeout=180), "warmup did not finish"
+    # warmed engine serves traffic normally
+    out = engine.submit(frames=frame).result(timeout=60)
+    assert out.shape[-1] == 7
