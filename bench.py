@@ -38,6 +38,13 @@ def main() -> int:
                    help="batches in flight (device queue depth)")
     p.add_argument("--wire", choices=["i420", "bgr"], default="i420")
     p.add_argument(
+        "--config",
+        choices=["detect_classify", "detect", "action", "audio"],
+        default="detect_classify",
+        help="which engine program to benchmark (BASELINE.md configs: "
+        "detect=1/3, detect_classify=2/5, action=4, audio=extra)",
+    )
+    p.add_argument(
         "--ingest", choices=["device", "host"], default="device",
         help="device: frames synthesized on-chip (measures the XLA "
         "program; default because this environment tunnels the TPU at "
@@ -65,18 +72,40 @@ def main() -> int:
     log(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}")
 
     registry = ModelRegistry()
-    det = registry.get("object_detection/person_vehicle_bike")
-    cls = registry.get("object_classification/vehicle_attributes")
-    step = step_builders.build_detect_classify_step(
-        det, cls, wire_format=args.wire
-    )
-    params = jax.device_put({"det": det.params, "cls": cls.params})
-
     b, h, w = args.batch, args.height, args.width
-    if args.wire == "i420":
+    if args.config == "detect_classify":
+        det = registry.get("object_detection/person_vehicle_bike")
+        cls = registry.get("object_classification/vehicle_attributes")
+        step = step_builders.build_detect_classify_step(
+            det, cls, wire_format=args.wire
+        )
+        params = {"det": det.params, "cls": cls.params}
+    elif args.config == "detect":
+        det = registry.get("object_detection/person_vehicle_bike")
+        step = step_builders.build_detect_step(det, wire_format=args.wire)
+        params = det.params
+    elif args.config == "action":
+        enc = registry.get("action_recognition/encoder")
+        step = step_builders.build_action_encode_step(
+            enc, wire_format=args.wire
+        )
+        params = enc.params
+    else:  # audio
+        aud = registry.get("audio_detection/environment")
+        step = step_builders.build_audio_step(aud)
+        params = aud.params
+        args.wire = "none"
+    params = jax.device_put(params)
+
+    if args.config == "audio":
+        wire_shape = (b, 16000)  # 1 s windows at 16 kHz
+    elif args.wire == "i420":
         wire_shape = (b, h * 3 // 2, w)
     else:
         wire_shape = (b, h, w, 3)
+
+    input_name = "windows" if args.config == "audio" else "frames"
+    wire_dtype = np.int16 if args.config == "audio" else np.uint8
 
     if args.ingest == "device":
         import jax.numpy as jnp
@@ -92,8 +121,8 @@ def main() -> int:
             # possible op surface on experimental backends.
             i = jax.lax.iota(jnp.uint32, n_elems)
             bits = (i * jnp.uint32(2654435761) + seed.astype(jnp.uint32))
-            frames = (bits >> 13).astype(jnp.uint8).reshape(wire_shape)
-            return base_step(params, frames=frames)
+            data = (bits >> 13).astype(jnp.dtype(wire_dtype))
+            return base_step(params, **{input_name: data.reshape(wire_shape)})
 
         fn = jax.jit(seeded_step)
         inputs = [np.int32(0), np.int32(1)]
@@ -103,9 +132,11 @@ def main() -> int:
         rng = np.random.default_rng(0)
         # A couple of distinct host batches so transfers aren't cached.
         host_batches = [
-            rng.integers(0, 255, wire_shape, dtype=np.uint8) for _ in range(2)
+            rng.integers(0, 255, wire_shape).astype(wire_dtype)
+            for _ in range(2)
         ]
-        submit = lambda i: fn(params, frames=jax.device_put(host_batches[i % 2]))
+        submit = lambda i: fn(
+            params, **{input_name: jax.device_put(host_batches[i % 2])})
 
     t0 = time.perf_counter()
     out = submit(0)
@@ -148,8 +179,13 @@ def main() -> int:
         f"({streams:.1f} x 1080p30 streams); batch-latency "
         f"p50={p50:.1f}ms p99={p99:.1f}ms (depth {args.depth})")
 
+    metric = (
+        "streams_1080p_30fps_per_chip"
+        if args.config in ("detect_classify", "detect")
+        else f"{args.config}_streams_30fps_per_chip"
+    )
     print(json.dumps({
-        "metric": "streams_1080p_30fps_per_chip",
+        "metric": metric,
         "value": round(streams, 2),
         "unit": "streams",
         "vs_baseline": round(streams / 16.0, 3),

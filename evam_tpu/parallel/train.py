@@ -42,6 +42,12 @@ from evam_tpu.parallel.ring import make_flax_attention_fn
 log = get_logger("parallel.train")
 
 
+def _ckpt_path(path):
+    import os
+
+    return os.path.abspath(os.fspath(path))
+
+
 def factor_mesh(n: int) -> tuple[int, int, int]:
     """Split n devices into (data, seq, model) sizes.
 
@@ -157,6 +163,33 @@ class ActionTrainer:
 
     def data_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P("data", "seq"))
+
+    # ---------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, state, path) -> None:
+        """Persist the (sharded) train state with orbax — the training
+        half of SURVEY.md §5.4 (serving-side resume lives in
+        server/registry.py; XLA executable cache in obs/trace.py)."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(_ckpt_path(path), state, force=True)
+
+    def restore_checkpoint(self, path):
+        """Restore onto this trainer's mesh/shardings (works across
+        process restarts and different mesh layouts — orbax reshards)."""
+        import orbax.checkpoint as ocp
+
+        example = jax.eval_shape(lambda: self.init_state(0))
+        abstract = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sh
+            ),
+            example,
+            self.state_shardings,
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(_ckpt_path(path), abstract)
 
     def shard_batch(self, clips: np.ndarray, labels: np.ndarray):
         clip_sh = NamedSharding(self.mesh, P("data", "seq", None, None, None))

@@ -1,0 +1,65 @@
+"""Opt-in soak test (EVAM_SOAK=1): sustained multi-stream run with
+fault injection — the concurrency/race stress pass (SURVEY.md §5.2:
+the reference relies on queue/event patterns with no sanitizer; here
+the same design is soaked under injected drops/stalls/errors)."""
+
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from evam_tpu.config import Settings
+from evam_tpu.engine import EngineHub
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+from evam_tpu.server.registry import PipelineRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("EVAM_SOAK"),
+    reason="soak test: set EVAM_SOAK=1 (runs ~2 min)",
+)
+
+
+def test_soak_faulty_streams(monkeypatch):
+    monkeypatch.setenv("EVAM_FAULT_INJECT",
+                       "drop=0.05,stall=0.01,stall_ms=50,error=0.02")
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    hub = EngineHub(
+        ModelRegistry(dtype="float32", input_overrides=SMALL,
+                      width_overrides=NARROW),
+        plan=build_mesh(), max_batch=16, deadline_ms=4.0,
+    )
+    registry = PipelineRegistry(settings, hub=hub)
+    try:
+        instances = [
+            registry.start_instance(
+                "object_detection", "person_vehicle_bike",
+                {
+                    "source": {
+                        "uri": f"synthetic://96x96@30?count=200&seed={i}",
+                        "type": "uri",
+                    },
+                    "destination": {"metadata": {"type": "null"}},
+                },
+            )
+            for i in range(8)
+        ]
+        deadline = time.time() + 300
+        for inst in instances:
+            inst.wait(timeout=max(1, deadline - time.time()))
+        # Faults must degrade frames, never kill streams or the engine.
+        assert all(i.state.value == "COMPLETED" for i in instances), [
+            (i.state.value, i.error) for i in instances
+        ]
+        total_out = sum(i._runner.frames_out for i in instances)
+        total_err = sum(i._runner.errors for i in instances)
+        assert total_out > 8 * 200 * 0.7
+        assert total_err > 0
+    finally:
+        registry.stop_all()
