@@ -28,6 +28,66 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _metric_for(cfg: str) -> str:
+    return (
+        "streams_1080p_30fps_per_chip"
+        if cfg in ("detect_classify", "detect")
+        else f"{cfg}_streams_30fps_per_chip"
+    )
+
+
+def fail_line(metric: str, reason: str) -> int:
+    """Emit the structured one-line JSON contract even on failure.
+
+    The round-1 bench died with a raw traceback when the axon tunnel
+    was wedged (BENCH_r01.json rc=1, parsed:null). The driver needs a
+    parseable line either way; a wedged/unreachable TPU is reported as
+    value 0 with an ``error`` field rather than a crash.
+    """
+    log(f"BENCH FAILURE: {reason}")
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "streams",
+        "vs_baseline": 0.0,
+        "error": reason,
+    }))
+    return 0
+
+
+def probe_device(platform: str | None, timeout_s: float) -> tuple[bool, str]:
+    """Run a trivial jitted matmul in a subprocess with a hard timeout.
+
+    The axon TPU tunnel in this environment can wedge globally — when it
+    does, even backend init hangs forever in every process, so the probe
+    must be a separate killable process, not an in-process try/except.
+    """
+    import subprocess
+
+    code = (
+        "import os, jax\n"
+        f"plat = {platform!r}\n"
+        "if plat: jax.config.update('jax_platforms', plat)\n"
+        "import jax.numpy as jnp\n"
+        "d = jax.devices()[0]\n"
+        "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+        "v = float(jax.jit(lambda a: (a @ a).sum())(x))\n"
+        "print(f'probe ok: {d.platform} {v}')\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s (tunnel wedged?)"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
+        return False, f"probe rc={r.returncode}: {tail[0]}"
+    log(r.stdout.strip())
+    return True, ""
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=32)
@@ -52,16 +112,29 @@ def main() -> int:
         "host: real host->device transfer per batch (the deployment "
         "number on a TPU VM with PCIe-attached chips)",
     )
+    p.add_argument("--probe-timeout", type=float, default=150.0,
+                   help="seconds to wait for the device-probe subprocess")
+    p.add_argument("--skip-probe", action="store_true")
     args = p.parse_args()
 
     import os
 
-    import jax
+    metric_name = _metric_for(args.config)
 
     # The image's .axon_site hook rewrites JAX_PLATFORMS at jax import;
     # re-assert the caller's explicit platform choice (conftest.py does
     # the same for tests).
     want = os.environ.get("BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS_ORIG")
+
+    # The probe guards against the axon TPU tunnel wedging; the CPU
+    # backend can't wedge, so skip the extra subprocess there.
+    if not args.skip_probe and want != "cpu":
+        ok, reason = probe_device(want, args.probe_timeout)
+        if not ok:
+            return fail_line(metric_name, f"device unavailable: {reason}")
+
+    import jax
+
     if want:
         jax.config.update("jax_platforms", want)
 
@@ -179,13 +252,8 @@ def main() -> int:
         f"({streams:.1f} x 1080p30 streams); batch-latency "
         f"p50={p50:.1f}ms p99={p99:.1f}ms (depth {args.depth})")
 
-    metric = (
-        "streams_1080p_30fps_per_chip"
-        if args.config in ("detect_classify", "detect")
-        else f"{args.config}_streams_30fps_per_chip"
-    )
     print(json.dumps({
-        "metric": metric,
+        "metric": metric_name,
         "value": round(streams, 2),
         "unit": "streams",
         "vs_baseline": round(streams / 16.0, 3),
@@ -193,5 +261,22 @@ def main() -> int:
     return 0
 
 
+def _argv_metric() -> str:
+    """Metric name for the crash handler, from --config in argv."""
+    cfg = "detect_classify"
+    for i, a in enumerate(sys.argv):
+        if a == "--config" and i + 1 < len(sys.argv):
+            cfg = sys.argv[i + 1]
+        elif a.startswith("--config="):
+            cfg = a.split("=", 1)[1]
+    return _metric_for(cfg)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as exc:  # noqa: BLE001 — the one-line contract holds even on crash
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        sys.exit(fail_line(_argv_metric(), f"{type(exc).__name__}: {exc}"))
