@@ -37,6 +37,13 @@ def cmd_list(args) -> int:
 
 
 def cmd_fetch_models(args) -> int:
+    if args.from_ir:
+        from evam_tpu.models.fetch import import_ir_dir
+
+        return import_ir_dir(
+            args.from_ir, args.output,
+            alias=args.alias, version=args.version, precision=args.precision,
+        )
     from evam_tpu.models.fetch import fetch_models
 
     return fetch_models(
@@ -68,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--model-list", default="models_list/models.list.yml")
     f.add_argument("--output", default="models")
     f.add_argument("--force", action="store_true")
+    f.add_argument("--from-ir", default=None, metavar="DIR",
+                   help="install OpenVINO IR .xml/.bin (file or tree) "
+                        "into the serving layout instead of zoo export")
+    f.add_argument("--alias", default=None,
+                   help="serving alias for --from-ir (default: xml stem)")
+    f.add_argument("--version", default="1")
+    f.add_argument("--precision", default="FP32")
     f.set_defaults(fn=cmd_fetch_models)
 
     ls = sub.add_parser("list", help="list pipelines and models")

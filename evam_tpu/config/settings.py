@@ -60,6 +60,9 @@ class Settings(BaseModel):
     dev_mode: bool = True  # DEV_MODE, reference evas/__main__.py:36
     profiling_mode: bool = False  # reference eii/docker-compose.yml:43
     state_dir: str = ""  # stream-registry persistence (hardening, SURVEY §5.4)
+    #: comma list of pipelines (name or name/version) or "all" to
+    #: build+warm engines before the REST port opens (EVAM_PRELOAD)
+    preload: str = ""
     tpu: TPUSettings = Field(default_factory=TPUSettings)
 
     @classmethod
@@ -84,6 +87,7 @@ class Settings(BaseModel):
             "DEV_MODE": ("dev_mode", _parse_bool),
             "PROFILING_MODE": ("profiling_mode", _parse_bool),
             "EVAM_STATE_DIR": ("state_dir", str),
+            "EVAM_PRELOAD": ("preload", str),
         }
         for var, (key, conv) in mapping.items():
             if var in env:

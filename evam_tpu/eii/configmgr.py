@@ -3,19 +3,33 @@
 The reference reads service config from etcd through the EII
 ConfigManager C binding (`cfg.ConfigMgr()` at evas/__main__.py:34;
 app config + publisher/subscriber interfaces at evas/manager.py:58,
-80-91; TLS certs via CONFIGMGR_* env, eii/docker-compose.yml:61-63).
-etcd3 is not in this image, so the store is a local JSON file with the
-same two-section shape as the reference's eii/config.json
-(``config`` + ``interfaces``) plus an mtime-poll watcher that delivers
-hot-reload callbacks — the reference declares this callback but stubs
-it (`_config_update_callback`, evas/manager.py:157-162); here it
-works.
+80-91; etcd env at eii/docker-compose.yml:44-47, TLS certs via
+CONFIGMGR_* env at :61-63). Two backends behind the same API:
+
+* **file** (default): a local JSON file with the same two-section
+  shape as the reference's eii/config.json (``config`` +
+  ``interfaces``), mtime-poll watcher;
+* **etcd** (``EVAM_ETCD_HOST``/``ETCD_HOST`` set): the etcd v3
+  gRPC-gateway HTTP/JSON API (`POST /v3/kv/range`) with keys
+  ``{ETCD_PREFIX}/config`` and ``{ETCD_PREFIX}/interfaces``,
+  mod_revision-poll watcher (documented divergence: the C binding
+  holds a streaming watch; polling keeps this stdlib-only), optional
+  TLS via ``CONFIGMGR_CACERT``/``CONFIGMGR_CERT``/``CONFIGMGR_KEY``.
+
+Both deliver working hot-reload callbacks — the reference declares
+this callback but stubs it (`_config_update_callback`,
+evas/manager.py:157-162); here it works, and a dead etcd falls back
+to the file store so boot never blocks on the control plane.
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import os
+import ssl
 import threading
+import urllib.request
 from pathlib import Path
 from typing import Any, Callable
 
@@ -50,26 +64,140 @@ DEFAULT_CONFIG: dict[str, Any] = {
 }
 
 
+class EtcdGatewayStore:
+    """etcd v3 HTTP/JSON gateway client (stdlib-only).
+
+    Reads ``{prefix}/config`` and ``{prefix}/interfaces`` (JSON
+    values — the layout the reference provisions per-app into etcd).
+    ``version()`` is the max mod_revision, the etcd analogue of the
+    file store's mtime.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 2379,
+        prefix: str = "/evam_tpu",
+        cacert: str | None = None,
+        cert: str | None = None,
+        key: str | None = None,
+        timeout_s: float = 5.0,
+    ):
+        # TLS keys on ANY of the cert vars — client-cert-only (CA in
+        # the system trust store) must not silently downgrade to http
+        use_tls = bool(cacert or cert or key)
+        scheme = "https" if use_tls else "http"
+        self.base = f"{scheme}://{host}:{port}"
+        self.prefix = prefix.rstrip("/")
+        self.timeout_s = timeout_s
+        self._ctx: ssl.SSLContext | None = None
+        if use_tls:
+            self._ctx = ssl.create_default_context(
+                cafile=cacert if cacert else None)
+            if cert and key:
+                self._ctx.load_cert_chain(cert, key)
+
+    def _range(self, key: str) -> tuple[dict | None, int]:
+        payload = json.dumps(
+            {"key": base64.b64encode(key.encode()).decode()}
+        ).encode()
+        req = urllib.request.Request(
+            f"{self.base}/v3/kv/range", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.timeout_s, context=self._ctx
+        ) as resp:
+            body = json.loads(resp.read())
+        kvs = body.get("kvs") or []
+        if not kvs:
+            return None, 0
+        value = json.loads(base64.b64decode(kvs[0]["value"]))
+        return value, int(kvs[0].get("mod_revision", 0))
+
+    def load(self) -> dict[str, Any]:
+        data: dict[str, Any] = {}
+        cfg, _ = self._range(f"{self.prefix}/config")
+        ifaces, _ = self._range(f"{self.prefix}/interfaces")
+        if cfg is None and ifaces is None:
+            # single-document fallback: the whole config.json at the prefix
+            doc, _ = self._range(self.prefix)
+            if doc is None:
+                raise KeyError(
+                    f"no config at etcd keys {self.prefix}[/config]"
+                )
+            return doc
+        if cfg is not None:
+            data["config"] = cfg
+        if ifaces is not None:
+            data["interfaces"] = ifaces
+        return data
+
+    def version(self) -> float:
+        revs = []
+        for key in (f"{self.prefix}/config", f"{self.prefix}/interfaces",
+                    self.prefix):
+            try:
+                _, rev = self._range(key)
+                revs.append(rev)
+            except Exception:  # noqa: BLE001 — transient gateway error
+                return -1.0  # forces no-change (retry next poll)
+        return float(max(revs))
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "EtcdGatewayStore | None":
+        host = env.get("EVAM_ETCD_HOST") or env.get("ETCD_HOST")
+        if not host:
+            return None
+        return cls(
+            host=host,
+            port=int(env.get("ETCD_CLIENT_PORT", "2379")),
+            prefix=env.get("ETCD_PREFIX", "/evam_tpu"),
+            cacert=env.get("CONFIGMGR_CACERT") or None,
+            cert=env.get("CONFIGMGR_CERT") or None,
+            key=env.get("CONFIGMGR_KEY") or None,
+        )
+
+
 class ConfigMgr:
     def __init__(
         self,
         config_file: str | Path | None = None,
         watch_interval_s: float = 2.0,
+        etcd: EtcdGatewayStore | None = None,
     ):
         self.config_file = Path(config_file) if config_file else None
         self.watch_interval_s = watch_interval_s
-        self._data = self._load()
-        self._mtime = self._stat_mtime()
+        self.etcd = etcd if etcd is not None else EtcdGatewayStore.from_env()
+        if self.etcd is not None:
+            try:
+                self._data = self.etcd.load()
+                self._mtime = self.etcd.version()
+                log.info("config from etcd gateway %s (rev %d)",
+                         self.etcd.base, int(self._mtime))
+            except Exception as exc:  # noqa: BLE001 — dead control plane
+                log.warning(
+                    "etcd gateway %s unavailable (%s); falling back to "
+                    "file store", self.etcd.base, exc,
+                )
+                self.etcd = None
+        if self.etcd is None:
+            self._data = self._load()
+            self._mtime = self._stat_mtime()
         self._watcher: threading.Thread | None = None
         self._stop = threading.Event()
         self._callbacks: list[Callable[[dict], None]] = []
 
     def _load(self) -> dict[str, Any]:
+        if self.etcd is not None:
+            return self.etcd.load()
         if self.config_file and self.config_file.exists():
             return json.loads(self.config_file.read_text())
         return json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
 
     def _stat_mtime(self) -> float:
+        if self.etcd is not None:
+            return self.etcd.version()
         try:
             return self.config_file.stat().st_mtime if self.config_file else 0.0
         except OSError:
@@ -99,7 +227,8 @@ class ConfigMgr:
         """Hot-reload hook (working version of the reference's stubbed
         `_config_update_callback`)."""
         self._callbacks.append(callback)
-        if self._watcher is None and self.config_file is not None:
+        watchable = self.config_file is not None or self.etcd is not None
+        if self._watcher is None and watchable:
             self._watcher = threading.Thread(
                 target=self._watch_loop, name="configmgr-watch", daemon=True
             )
@@ -108,13 +237,19 @@ class ConfigMgr:
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.watch_interval_s):
             mtime = self._stat_mtime()
+            if mtime < 0:
+                continue  # transient etcd gateway error: retry next poll
             if mtime != self._mtime:
-                self._mtime = mtime
                 try:
                     self._data = self._load()
-                except (OSError, json.JSONDecodeError) as exc:
+                except Exception as exc:  # noqa: BLE001 — bad file/gateway blip
+                    # do NOT commit mtime: unlike the file store (whose
+                    # mtime changes again on the next edit), an etcd
+                    # revision only moves on writes — committing before
+                    # a successful load would drop this update forever
                     log.warning("config reload failed: %s", exc)
                     continue
+                self._mtime = mtime
                 log.info("config file changed; notifying %d watcher(s)",
                          len(self._callbacks))
                 for cb in self._callbacks:

@@ -83,9 +83,15 @@ class EiiManager:
 
         self.publisher: MsgBusPublisher | None = None
         self._pub_cfg_snapshot: str = ""
+        #: last hot-reload failure message (None = healthy); the last
+        #: config that produced a running pipeline backs the fallback.
+        self.reload_error: str | None = None
+        self._last_good_cfg: dict[str, Any] | None = None
         self._build_publisher()
 
-        self._start_pipeline(self.cfg.get_app_config())
+        boot_cfg = self.cfg.get_app_config()
+        self._start_pipeline(boot_cfg)
+        self._last_good_cfg = boot_cfg
         # Working hot-reload: restart the pipeline when the config
         # store changes.
         self.cfg.watch(self._on_config_update)
@@ -102,10 +108,13 @@ class EiiManager:
         snapshot = _json.dumps(pub_cfg, sort_keys=True)
         if self.publisher is not None and snapshot == self._pub_cfg_snapshot:
             return
+        topics = pub_cfg.get("Topics") or ["evam_tpu"]
+        # build-then-swap: a failing new publisher must leave the old
+        # one usable for the hot-reload fallback path
+        new_pub = MsgBusPublisher(pub_cfg, topics[0])
         if self.publisher is not None:
             self.publisher.close()
-        topics = pub_cfg.get("Topics") or ["evam_tpu"]
-        self.publisher = MsgBusPublisher(pub_cfg, topics[0])
+        self.publisher = new_pub
         self._pub_cfg_snapshot = snapshot
 
     # ------------------------------------------------------- pipeline
@@ -185,9 +194,32 @@ class EiiManager:
         if self.instance is not None:
             self.registry.stop_instance(self.instance.id)
             self.instance.wait(timeout=10)
+            self.instance = None
         self._teardown_ingest()
-        self._build_publisher()
-        self._start_pipeline(self.cfg.get_app_config())
+        try:
+            # publisher rebuild and config fetch can fail on a bad
+            # Publishers entry too — everything after the old pipeline
+            # stopped must fall back, or the service is left silently
+            # pipeline-less while reporting healthy
+            self._build_publisher()
+            new_cfg = self.cfg.get_app_config()
+            self._start_pipeline(new_cfg)
+        except Exception as exc:  # noqa: BLE001 — keep serving on bad reload
+            # A bad new config must not leave the service silently
+            # pipeline-less (the watch loop swallows exceptions): fall
+            # back to the last known-good config and flag the failure
+            # so /healthz-style monitoring can see it.
+            log.error("hot-reload failed (%s); reverting to last "
+                      "known-good config", exc)
+            self.reload_error = str(exc)
+            if self._last_good_cfg is not None:
+                try:
+                    self._start_pipeline(self._last_good_cfg)
+                except Exception as exc2:  # noqa: BLE001
+                    log.error("fallback restart also failed: %s", exc2)
+            return
+        self.reload_error = None
+        self._last_good_cfg = new_cfg
 
     # -------------------------------------------------------- publish
 

@@ -137,9 +137,28 @@ class EngineHub:
                     "batches": e.stats.batches,
                     "items": e.stats.items,
                     "mean_occupancy": e.stats.mean_occupancy,
+                    "warmed": e.warmed.is_set(),
                 }
                 for k, e in self._engines.items()
             }
+
+    def readiness(self) -> dict[str, int]:
+        """Engine warm state for /healthz (serve-time preload,
+        round-1 VERDICT item 7): ``warming`` > 0 means a first POST
+        would still hit a compile in the hot path."""
+        with self._lock:
+            engines = list(self._engines.values())
+        # without background warmup the event never fires — engines
+        # compile on first batch and are "as ready as they get"
+        warmed = (
+            sum(1 for e in engines if e.warmed.is_set())
+            if self.warmup else len(engines)
+        )
+        return {
+            "engines": len(engines),
+            "warmed": warmed,
+            "warming": len(engines) - warmed,
+        }
 
     def stop(self) -> None:
         with self._lock:

@@ -37,12 +37,25 @@ from evam_tpu.ops.preprocess import (
 DETECT_FIELDS = 7
 
 
+def _head_probs(model, name: str, out) -> jnp.ndarray:
+    """Per-head probabilities, honoring in-graph SoftMax of IR imports."""
+    x = out[name].astype(jnp.float32)
+    if model.head_is_prob.get(name, False):
+        return x
+    return jax.nn.softmax(x, axis=-1)
+
+
 def _detect_packed(params, bgr, model, anchors, max_detections,
                    iou_threshold, score_threshold):
     x = preprocess_bgr(bgr, model.preprocess)
     out = model.forward(params, x)
-    boxes = decode_boxes(out["loc"].astype(jnp.float32), anchors)
-    scores = jax.nn.softmax(out["conf"].astype(jnp.float32), axis=-1)
+    boxes = decode_boxes(
+        out["loc"].astype(jnp.float32), anchors, variances=model.variances
+    )
+    conf = out["conf"].astype(jnp.float32)
+    # IR-imported graphs usually softmax in-graph (OMZ convention,
+    # models/ir.py output_is_prob); re-softmaxing would flatten scores.
+    scores = conf if model.conf_is_prob else jax.nn.softmax(conf, axis=-1)
     bx, sc, lb, valid = batched_nms(
         boxes,
         scores,
@@ -140,10 +153,7 @@ def build_detect_classify_step(
         cls_in = preprocess_bgr(crops, cls_pre)
         out = cls_model.forward(params["cls"], cls_in)
         probs = jnp.concatenate(
-            [
-                jax.nn.softmax(out[name].astype(jnp.float32), axis=-1)
-                for name, _ in cls_model.spec.heads
-            ],
+            [_head_probs(cls_model, name, out) for name, _ in cls_model.spec.heads],
             axis=-1,
         ).reshape(b, roi_budget, head_total)
         probs = probs * roi_ok[..., None]
@@ -178,10 +188,7 @@ def build_classify_step(
         crops = crops.reshape((b * r,) + crops.shape[2:])
         x = preprocess_bgr(crops, preproc)
         out = forward(params, x)  # dict head -> [B*R, n]
-        probs = [
-            jax.nn.softmax(out[name].astype(jnp.float32), axis=-1)
-            for name, _ in model.spec.heads
-        ]
+        probs = [_head_probs(model, name, out) for name, _ in model.spec.heads]
         packed = jnp.concatenate(probs, axis=-1)
         return packed.reshape(b, r, sum(head_sizes))
 

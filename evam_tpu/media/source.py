@@ -215,22 +215,29 @@ class AppSource:
         self.push(frame, pts_ns)
 
     def end(self) -> None:
+        # _closed doubles as the EOS signal: frames() re-checks it on
+        # every queue timeout, so EOS delivery cannot be lost even if a
+        # concurrent push()'s drop-oldest get_nowait() consumes the
+        # in-band None sentinel (the sentinel is only a wake-up
+        # accelerator, not the source of truth).
         self._closed = True
-        while True:
-            try:
-                self._queue.put_nowait(None)
-                return
-            except queue.Full:
-                try:
-                    self._queue.get_nowait()
-                except queue.Empty:
-                    pass
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # frames() will notice _closed on its next timeout
 
     def frames(self) -> Iterator[FrameEvent]:
         while True:
-            ev = self._queue.get()
+            try:
+                ev = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed:
+                    break
+                continue
             if ev is None:
-                break
+                if self._closed:
+                    break
+                continue  # stale sentinel displaced by a late push
             yield ev
 
     def close(self) -> None:

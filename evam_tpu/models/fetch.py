@@ -140,3 +140,81 @@ def fetch_models(
             proc_path.write_text(json.dumps(proc, indent=2) + "\n")
     log.info("fetched %d manifest entries (%d failures)", len(entries), failures)
     return 1 if failures else 0
+
+
+def import_ir_dir(
+    ir_dir: str | Path,
+    output: str | Path,
+    alias: str | None = None,
+    version: str = "1",
+    precision: str = "FP32",
+) -> int:
+    """``fetch-models --from-ir``: install OpenVINO IR model(s) into
+    the serving layout and smoke-import each one.
+
+    ``ir_dir`` may point at a single ``model.xml`` (with sibling
+    ``.bin``) or a directory tree of them (the OMZ download layout).
+    Each IR is copied to ``{output}/{alias}/{version}/{precision}/``
+    and loaded once through models/ir.py to fail fast on unsupported
+    topologies. The serving path then picks the IR up directly
+    (ModelRegistry._ir_xml_path).
+    """
+    import shutil
+
+    from evam_tpu.models.ir import load_ir
+
+    src = Path(ir_dir)
+    xmls = [src] if src.suffix == ".xml" else sorted(src.rglob("*.xml"))
+    xmls = [x for x in xmls if x.with_suffix(".bin").exists()]
+    if not xmls:
+        log.error("no .xml with sibling .bin under %s", src)
+        return 1
+    if alias is not None and "/" in alias:
+        # the registry key is {alias}/{version}; a slashed alias
+        # would install at a depth _ir_xml_path never resolves (e.g.
+        # for key "object_detection/person" pass --alias
+        # object_detection --version person)
+        log.error(
+            "--alias %r must not contain '/': the serving key is "
+            "{alias}/{version} — pass the second segment via --version",
+            alias,
+        )
+        return 1
+    if alias is not None and len(xmls) > 1:
+        # distinct models silently sharing one alias dir would leave
+        # the registry serving an arbitrary one (sorted()[0])
+        log.error(
+            "--alias %s with %d IR files under %s — pass a single "
+            ".xml with --alias, or omit it to alias each by stem",
+            alias, len(xmls), src,
+        )
+        return 1
+    failures = 0
+    seen_targets: set = set()
+    for xml in xmls:
+        name = alias or xml.stem
+        try:
+            model = load_ir(xml)
+        except Exception as exc:  # noqa: BLE001 — report and continue
+            log.error("cannot import %s: %s", xml, exc)
+            failures += 1
+            continue
+        target = Path(output) / name / version / precision
+        if target in seen_targets:
+            # same stem at multiple tree depths (e.g. FP16/ and FP32/
+            # copies in an OMZ download): the second would clobber the
+            # first with different-precision weights
+            log.error("duplicate IR stem %r — %s already installed; "
+                      "import precisions separately with --precision",
+                      name, target)
+            failures += 1
+            continue
+        seen_targets.add(target)
+        target.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(xml, target / xml.name)
+        shutil.copy2(xml.with_suffix(".bin"), target / xml.with_suffix(".bin").name)
+        log.info(
+            "installed IR %s -> %s (input %s, outputs %s)",
+            xml.name, target, model.input_shape, model.output_names,
+        )
+    return 1 if failures else 0

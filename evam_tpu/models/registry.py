@@ -128,14 +128,47 @@ class LoadedModel:
     labels: list[str] = field(default_factory=list)
     head_labels: dict[str, list[str]] = field(default_factory=dict)
     anchors: np.ndarray | None = None
+    #: SSD box-decode variances (IR imports carry the model's own)
+    variances: tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
+    #: True when the model emits probabilities (in-graph SoftMax, the
+    #: OMZ convention) so engine steps must not re-softmax
+    conf_is_prob: bool = False
+    head_is_prob: dict[str, bool] = field(default_factory=dict)
+    #: set when backed by an imported OpenVINO IR graph (models/ir.py)
+    ir: Any = None
 
     @property
     def forward(self) -> Callable:
         """Pure apply: (params, batch) → raw outputs."""
+        if self.ir is not None:
+            return self._ir_forward()
         module = self.module
 
         def fn(params, batch):
             return module.apply({"params": params}, batch)
+
+        return fn
+
+    def _ir_forward(self) -> Callable:
+        """Wrap the imported IR graph executor: the engine feeds NHWC
+        frames (TPU-friendly), the IR convention is NCHW; detector
+        outputs are reshaped to the zoo contract ({'loc': [B,A,4],
+        'conf': [B,A,C]})."""
+        import jax.numpy as jnp
+
+        ir = self.ir
+        num_classes = self.spec.num_classes
+
+        def fn(params, batch):
+            x = jnp.transpose(batch, (0, 3, 1, 2))
+            out = ir.forward(params, x)
+            if ir.is_detector:
+                b = batch.shape[0]
+                return {
+                    "loc": out["loc"].reshape(b, -1, 4),
+                    "conf": out["conf"].reshape(b, -1, num_classes),
+                }
+            return {k: v.reshape(v.shape[0], -1) for k, v in out.items()}
 
         return fn
 
@@ -173,6 +206,19 @@ def _seed_for(key: str) -> int:
     return int.from_bytes(hashlib.sha256(key.encode()).digest()[:4], "little")
 
 
+def _cast_params(params, dtype: str):
+    """Cast every floating leaf to the serving precision (one shared
+    implementation for zoo- and IR-loaded weights)."""
+    if dtype != "bfloat16":
+        return params
+    return jax.tree.map(
+        lambda x: jnp.asarray(x, jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        else jnp.asarray(x),
+        params,
+    )
+
+
 class ModelRegistry:
     """Builds and caches models, resolving weights/procs from disk.
 
@@ -202,16 +248,23 @@ class ModelRegistry:
         return self._cache[key]
 
     def keys(self) -> list[str]:
-        """Loadable model keys: the built-in zoo (on-disk weight dirs
-        only customize these; models outside the zoo need a zoo spec)."""
-        return sorted(ZOO_SPECS)
+        """Loadable model keys: the built-in zoo plus any on-disk
+        OpenVINO IR dirs (``{alias}/{version}/{precision}/*.xml``)."""
+        keys = set(ZOO_SPECS)
+        if self.models_dir and self.models_dir.exists():
+            for xml in self.models_dir.glob("*/*/*/*.xml"):
+                keys.add(f"{xml.parts[-4]}/{xml.parts[-3]}")
+        return sorted(keys)
 
     def _load(self, key: str) -> LoadedModel:
+        ir_xml = self._ir_xml_path(key)
+        if ir_xml is not None:
+            return self._load_ir(key, ir_xml)
         spec = ZOO_SPECS.get(key)
         if spec is None:
             raise KeyError(
-                f"unknown model '{key}' — not in the built-in zoo "
-                f"(known: {sorted(ZOO_SPECS)})"
+                f"unknown model '{key}' — not in the built-in zoo and "
+                f"no OpenVINO IR on disk (known: {sorted(ZOO_SPECS)})"
             )
         if key in self.input_overrides:
             spec = ModelSpec(**{**spec.__dict__, "input_size": self.input_overrides[key]})
@@ -250,6 +303,82 @@ class ModelRegistry:
             anchors=anchors,
         )
 
+    def _ir_xml_path(self, key: str) -> Path | None:
+        """Find an OpenVINO IR under the reference directory layout
+        ``models/{alias}/{version}/{precision}/*.xml`` (reference
+        README.md:44-52)."""
+        if not self.models_dir:
+            return None
+        base = self.models_dir / key
+        for precision in (self.precision, "FP32", "FP16"):
+            hits = sorted((base / precision).glob("*.xml"))
+            if hits:
+                return hits[0]
+        return None
+
+    def _load_ir(self, key: str, xml_path: Path) -> LoadedModel:
+        """Build a LoadedModel from an imported OpenVINO IR — the real
+        OMZ weights path (VERDICT round-1 item 3). The zoo spec (when
+        the key is a known alias) contributes labels/heads metadata;
+        topology and weights come from the IR."""
+        from evam_tpu.models.ir import load_ir
+
+        ir_model = load_ir(xml_path)
+        h, w = ir_model.input_hw
+        base = ZOO_SPECS.get(key)
+        if ir_model.is_detector:
+            family = "ssd"
+            num_classes = ir_model.num_classes or (base.num_classes if base else 2)
+            heads: tuple = ()
+        else:
+            family = "classifier"
+            num_classes = base.num_classes if base else 0
+            # _ir_forward flattens each output to [B, prod(rest)] — OMZ
+            # classifier IRs emit [1, C, 1, 1], so the head width is the
+            # product of the non-batch dims, not shape[-1]
+            heads = tuple(
+                (name, int(np.prod(shape[1:])) if len(shape) > 1 else 1)
+                for name, shape in zip(ir_model.output_names, ir_model.output_shapes)
+            )
+        spec = ModelSpec(
+            key=key,
+            family=family,
+            input_size=(h, w),
+            num_classes=num_classes,
+            heads=heads,
+            labels=base.labels if base else (),
+            head_labels=base.head_labels if base else (),
+            omz_name=base.omz_name if base else ir_model.name,
+        )
+
+        params = _cast_params(ir_model.params, self.dtype)
+
+        proc = self._find_model_proc(spec)
+        model_labels = list(spec.labels)
+        if proc and proc.labels_for(0):
+            model_labels = proc.labels_for(0)
+        preproc = PreprocessSpec(
+            height=h, width=w, color_space="BGR", dtype=self.dtype
+        )
+        if proc:
+            preproc = proc.preprocess_spec(h, w, dtype=self.dtype)
+
+        probs = dict(zip(ir_model.output_names, ir_model.output_is_prob))
+        return LoadedModel(
+            spec=spec,
+            module=None,
+            params=params,
+            preprocess=preproc,
+            model_proc=proc,
+            labels=model_labels,
+            head_labels={k: list(v) for k, v in spec.head_labels},
+            anchors=ir_model.anchors,
+            variances=ir_model.variances,
+            conf_is_prob=probs.get("conf", False),
+            head_is_prob=probs,
+            ir=ir_model,
+        )
+
     def _weights_path(self, spec: ModelSpec) -> Path | None:
         if not self.models_dir:
             return None
@@ -269,14 +398,7 @@ class ModelRegistry:
             params = serialization.from_bytes(params, path.read_bytes())
         else:
             log.info("no weights on disk for %s — deterministic random init", spec.key)
-        if self.dtype == "bfloat16":
-            params = jax.tree.map(
-                lambda x: x.astype(jnp.bfloat16)
-                if jnp.issubdtype(x.dtype, jnp.floating)
-                else x,
-                params,
-            )
-        return params
+        return _cast_params(params, self.dtype)
 
     def _find_model_proc(self, spec: ModelSpec) -> ModelProc | None:
         if not self.models_dir:

@@ -125,12 +125,18 @@ def crop_rois(
 
     def crop_one(img, box):
         x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
-        # Sample an oh x ow grid inside the box via gather (nearest).
+        # Sample an oh x ow grid inside the box (nearest). Two
+        # separable 1-D gathers (rows, then columns) instead of one
+        # oh*ow-point 2-D gather: XLA lowers contiguous row gathers to
+        # fast dynamic slices on TPU, while the 2-D point gather
+        # scatter-reads 3-element rows (measured ~45 ms/batch hot spot
+        # in round 2 profiling, see PROFILE.md).
         ys = y0 * (h - 1) + (y1 - y0) * (h - 1) * jnp.linspace(0.0, 1.0, oh)
         xs = x0 * (w - 1) + (x1 - x0) * (w - 1) * jnp.linspace(0.0, 1.0, ow)
         yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
         xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
-        return img[yi[:, None], xi[None, :], :]
+        rows = jnp.take(img, yi, axis=0)       # [oh, W, 3]
+        return jnp.take(rows, xi, axis=1)      # [oh, ow, 3]
 
     return jax.vmap(lambda img, bs: jax.vmap(lambda bb: crop_one(img, bb))(bs))(
         x, boxes

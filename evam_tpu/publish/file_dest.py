@@ -16,28 +16,49 @@ class FileDestination:
         self.path = path
         self.fmt = fmt
         self._lock = threading.Lock()
-        self._fh = open(path, "w", encoding="utf-8")
+        # Lazy open: the file is created/truncated on the first
+        # publish, not at construction, so a start request that fails
+        # later in build_stages (unknown model, bad stage) can't
+        # truncate an operator's existing output file. Parameter
+        # errors are caught even earlier (resolve_parameters runs
+        # before the destination is created).
+        self._fh = None
         self._first = True
-        if fmt == "json":
-            self._fh.write("[")
+        self._closed = False
+
+    def _ensure_open(self):
+        if self._fh is None:
+            self._fh = open(self.path, "w", encoding="utf-8")
+            if self.fmt == "json":
+                self._fh.write("[")
+        return self._fh
 
     def publish(self, meta: dict, frame: bytes | None = None) -> None:
         line = json.dumps(meta, separators=(",", ":"))
         with self._lock:
+            if self._closed:
+                # a late frame completing during teardown must not
+                # re-open (and truncate) the finished output file
+                return
+            fh = self._ensure_open()
             if self.fmt == "json":
                 if not self._first:
-                    self._fh.write(",\n")
+                    fh.write(",\n")
                 self._first = False
-                self._fh.write(line)
+                fh.write(line)
             else:
-                self._fh.write(line + "\n")
-            self._fh.flush()
+                fh.write(line + "\n")
+            fh.flush()
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
+            if self._fh is None:
+                return
             if self.fmt == "json":
                 self._fh.write("]\n")
             self._fh.close()
+            self._fh = None
 
 
 class StdoutDestination:

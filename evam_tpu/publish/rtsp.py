@@ -167,9 +167,15 @@ class FrameRelay:
             self.push_jpeg(buf.tobytes())
 
     def next_frame(self, last_gen: int, timeout: float = 2.0):
+        """Block until a frame newer than ``last_gen`` arrives.
+
+        Returns ``(None, last_gen)`` on timeout so serving loops only
+        send genuinely new frames — a stalled pipeline must not be
+        re-sent as a fresh RTP frame every timeout period."""
         with self._cond:
-            self._cond.wait_for(lambda: self._gen != last_gen, timeout)
-            return self._jpeg, self._gen
+            if self._cond.wait_for(lambda: self._gen != last_gen, timeout):
+                return self._jpeg, self._gen
+            return None, last_gen
 
 
 class RtspServer:

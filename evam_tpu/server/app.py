@@ -120,7 +120,11 @@ def build_app(
                             content_type="text/plain")
 
     async def healthz(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        ready = registry.hub.readiness()
+        return web.json_response({
+            "status": "warming" if ready["warming"] else "ok",
+            **ready,
+        })
 
     app.add_routes([
         web.get("/pipelines", list_pipelines),
@@ -164,6 +168,9 @@ def run_server(settings: Settings) -> int:
     # Resume AFTER frame-destination servers exist: a resumed stream's
     # destination.frame must re-mount on the live RTSP server.
     registry.resume()
+    if settings.preload:
+        n = registry.preload(settings.preload)
+        log.info("preloaded %d pipeline(s) before opening the port", n)
     log.info("REST serving on :%d %s", settings.rest_port,
              f"(+ {', '.join(extras)})" if extras else "")
     web.run_app(app, port=settings.rest_port, print=None)

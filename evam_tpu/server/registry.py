@@ -67,6 +67,44 @@ class PipelineRegistry:
         )
         self._persist_lock = threading.Lock()
 
+    # ------------------------------------------------------- preload
+
+    def preload(self, names: str) -> int:
+        """Serve-time engine preload (round-1 VERDICT item 7): build
+        the engines (and fire their background bucket warmup, when
+        ``tpu.warmup``) for the named pipelines BEFORE the REST port
+        opens, so the first POST never pays model build + XLA compile
+        in the hot path. ``names``: comma list of ``name/version`` (or
+        bare ``name`` = all versions), or ``all``.
+
+        Engines are cached in the hub by (kind, model-instance) —
+        building a throwaway stage chain per pipeline is exactly the
+        instance start path minus the stream, so later instances get
+        cache hits."""
+        from evam_tpu.graph.params import resolve_parameters
+        from evam_tpu.stages.build import build_stages
+
+        wanted = [n.strip() for n in names.split(",") if n.strip()]
+        count = 0
+        for name, version in self.loader.names():
+            label = f"{name}/{version}"
+            if "all" not in wanted and not any(
+                w in (name, label) for w in wanted
+            ):
+                continue
+            spec = self.loader.get(name, version)
+            try:
+                stage_specs, _ = resolve_parameters(spec, {})
+                build_stages(
+                    stage_specs, self.hub,
+                    publish_fn=lambda ctx: None, sink_fn=lambda ctx: None,
+                )
+                count += 1
+                log.info("preloaded %s", label)
+            except Exception as exc:  # noqa: BLE001 — preload is best-effort
+                log.warning("preload %s failed: %s", label, exc)
+        return count
+
     # ----------------------------------------------------- definitions
 
     def pipelines(self) -> list[dict[str, Any]]:

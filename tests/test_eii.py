@@ -63,6 +63,104 @@ class TestConfigMgr:
         assert seen and seen[0]["config"]["pipeline"] == "object_detection/person"
 
 
+class _FakeEtcdGateway:
+    """Minimal etcd v3 HTTP/JSON gateway (POST /v3/kv/range) backed by
+    an in-memory dict, for loopback-testing the etcd ConfigMgr
+    backend (reference control plane: evas/__main__.py:34 +
+    eii/docker-compose.yml:44-47)."""
+
+    def __init__(self):
+        import base64
+        import http.server
+        import threading
+
+        store = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                if self.path != "/v3/kv/range":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n))
+                key = base64.b64decode(req["key"]).decode()
+                body: dict = {}
+                if key in store.kv:
+                    value, rev = store.kv[key]
+                    body["kvs"] = [{
+                        "key": req["key"],
+                        "value": base64.b64encode(
+                            json.dumps(value).encode()).decode(),
+                        "mod_revision": str(rev),
+                    }]
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        self.kv: dict[str, tuple[dict, int]] = {}
+        self._rev = 0
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def put(self, key: str, value: dict) -> None:
+        self._rev += 1
+        self.kv[key] = (value, self._rev)
+
+    def close(self):
+        self.server.shutdown()
+
+
+class TestEtcdConfigMgr:
+    def test_load_and_watch_from_etcd(self):
+        from evam_tpu.eii.configmgr import EtcdGatewayStore
+
+        gw = _FakeEtcdGateway()
+        try:
+            gw.put("/evam_tpu/config", {"pipeline": "video_decode/app_dst"})
+            gw.put("/evam_tpu/interfaces",
+                   {"Publishers": [], "Subscribers": []})
+            store = EtcdGatewayStore("127.0.0.1", port=gw.port)
+            cfg = ConfigMgr(etcd=store, watch_interval_s=0.1)
+            assert cfg.etcd is not None
+            assert cfg.get_app_config()["pipeline"] == "video_decode/app_dst"
+
+            seen = []
+            cfg.watch(seen.append)
+            time.sleep(0.3)
+            gw.put("/evam_tpu/config", {"pipeline": "object_detection/person"})
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.05)
+            cfg.close()
+            assert seen
+            assert seen[0]["config"]["pipeline"] == "object_detection/person"
+        finally:
+            gw.close()
+
+    def test_dead_gateway_falls_back_to_file(self, tmp_path):
+        from evam_tpu.eii.configmgr import EtcdGatewayStore
+
+        f = tmp_path / "config.json"
+        f.write_text(json.dumps({
+            "config": {"pipeline": "video_decode/app_dst"},
+            "interfaces": {"Publishers": [], "Subscribers": []},
+        }))
+        # nothing listens on this port: boot must not block on etcd
+        store = EtcdGatewayStore("127.0.0.1", port=1, timeout_s=0.2)
+        cfg = ConfigMgr(config_file=f, etcd=store, watch_interval_s=0.1)
+        assert cfg.etcd is None  # fell back
+        assert cfg.get_app_config()["pipeline"] == "video_decode/app_dst"
+        cfg.close()
+
+
 class TestMsgBus:
     def test_ipc_roundtrip(self, tmp_path):
         cfg = {"Type": "zmq_ipc", "EndPoint": str(tmp_path / "socks")}

@@ -1,0 +1,422 @@
+"""Golden tests for the OpenVINO IR importer (evam_tpu/models/ir.py).
+
+Hand-written tiny IR fixtures (the format is plain XML + raw little-
+endian tensors, reference tools/model_downloader downloads real ones)
+are imported and executed; outputs are checked against independent
+numpy hand-computations — numeric fidelity, not just shape parity.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from evam_tpu.models.ir import build_ir_model, load_ir, parse_ir
+
+
+class IRBuilder:
+    """Compose a minimal IR v11 xml + bin pair."""
+
+    def __init__(self, name="testnet"):
+        self.name = name
+        self.layers: list[str] = []
+        self.edges: list[str] = []
+        self.blob = bytearray()
+        self._next_id = 0
+
+    def _shape_xml(self, port_id: int, shape) -> str:
+        dims = "".join(f"<dim>{d}</dim>" for d in shape)
+        return f'<port id="{port_id}">{dims}</port>'
+
+    def layer(self, ltype, attrs=None, inputs=(), out_shapes=((),), name=None):
+        """inputs: list of (layer_id, port_id, shape). Returns this
+        layer's id; its output ports are numbered after the inputs."""
+        lid = self._next_id
+        self._next_id += 1
+        name = name or f"{ltype.lower()}_{lid}"
+        attr_xml = ""
+        if attrs:
+            kv = " ".join(f'{k}="{v}"' for k, v in attrs.items())
+            attr_xml = f"<data {kv}/>"
+        in_xml = ""
+        if inputs:
+            ports = "".join(
+                self._shape_xml(i, shp) for i, (_, _, shp) in enumerate(inputs)
+            )
+            in_xml = f"<input>{ports}</input>"
+        first_out = len(inputs)
+        out_xml = "".join(
+            self._shape_xml(first_out + i, s) for i, s in enumerate(out_shapes)
+        )
+        self.layers.append(
+            f'<layer id="{lid}" name="{name}" type="{ltype}" version="opset1">'
+            f"{attr_xml}{in_xml}<output>{out_xml}</output></layer>"
+            if out_shapes
+            else f'<layer id="{lid}" name="{name}" type="{ltype}" '
+            f'version="opset1">{attr_xml}{in_xml}</layer>'
+        )
+        for to_port, (src_lid, src_port, _) in enumerate(inputs):
+            self.edges.append(
+                f'<edge from-layer="{src_lid}" from-port="{src_port}" '
+                f'to-layer="{lid}" to-port="{to_port}"/>'
+            )
+        return lid, first_out
+
+    def const(self, arr: np.ndarray, name=None):
+        arr = np.ascontiguousarray(arr)
+        et = {
+            np.dtype(np.float32): "f32",
+            np.dtype(np.int64): "i64",
+            np.dtype(np.float16): "f16",
+        }[arr.dtype]
+        offset = len(self.blob)
+        self.blob.extend(arr.tobytes())
+        attrs = {
+            "element_type": et,
+            "shape": ",".join(str(d) for d in arr.shape),
+            "offset": offset,
+            "size": arr.nbytes,
+        }
+        return self.layer("Const", attrs, out_shapes=(arr.shape,), name=name)
+
+    def result(self, src):
+        return self.layer("Result", inputs=[src], out_shapes=())
+
+    def write(self, tmpdir: Path, stem="model") -> Path:
+        xml = (
+            f'<?xml version="1.0"?><net name="{self.name}" version="11">'
+            f'<layers>{"".join(self.layers)}</layers>'
+            f'<edges>{"".join(self.edges)}</edges></net>'
+        )
+        xml_path = tmpdir / f"{stem}.xml"
+        xml_path.write_text(xml)
+        (tmpdir / f"{stem}.bin").write_bytes(bytes(self.blob))
+        return xml_path
+
+
+def _build_classifier_ir(tmp_path: Path, out_4d: bool = False):
+    """conv(1→2,3x3,pad1) + bias + relu + maxpool2 + reshape + matmul
+    + bias + softmax on a 4x4 input."""
+    rng = np.random.default_rng(42)
+    conv_w = rng.normal(size=(2, 1, 3, 3)).astype(np.float32)
+    bias = rng.normal(size=(1, 2, 1, 1)).astype(np.float32)
+    mm_w = rng.normal(size=(8, 3)).astype(np.float32)
+    bias2 = rng.normal(size=(1, 3)).astype(np.float32)
+
+    b = IRBuilder("tiny_classifier")
+    x = b.layer("Parameter", {"shape": "1,1,4,4", "element_type": "f32"},
+                out_shapes=((1, 1, 4, 4),), name="input")
+    wc = b.const(conv_w, "conv_w")
+    conv = b.layer(
+        "Convolution",
+        {"strides": "1,1", "pads_begin": "1,1", "pads_end": "1,1",
+         "dilations": "1,1", "auto_pad": "explicit"},
+        inputs=[(x[0], x[1], (1, 1, 4, 4)), (wc[0], wc[1], conv_w.shape)],
+        out_shapes=((1, 2, 4, 4),), name="conv",
+    )
+    wb = b.const(bias, "conv_b")
+    add = b.layer("Add", inputs=[(conv[0], conv[1], (1, 2, 4, 4)),
+                                 (wb[0], wb[1], bias.shape)],
+                  out_shapes=((1, 2, 4, 4),), name="bias_add")
+    relu = b.layer("ReLU", inputs=[(add[0], add[1], (1, 2, 4, 4))],
+                   out_shapes=((1, 2, 4, 4),), name="relu")
+    pool = b.layer(
+        "MaxPool",
+        {"kernel": "2,2", "strides": "2,2", "pads_begin": "0,0",
+         "pads_end": "0,0", "rounding_type": "floor"},
+        inputs=[(relu[0], relu[1], (1, 2, 4, 4))],
+        out_shapes=((1, 2, 2, 2),), name="pool",
+    )
+    tgt = b.const(np.asarray([1, 8], np.int64), "reshape_tgt")
+    resh = b.layer("Reshape", {"special_zero": "true"},
+                   inputs=[(pool[0], pool[1], (1, 2, 2, 2)),
+                           (tgt[0], tgt[1], (2,))],
+                   out_shapes=((1, 8),), name="flatten")
+    wm = b.const(mm_w, "fc_w")
+    mm = b.layer("MatMul", {"transpose_a": "false", "transpose_b": "false"},
+                 inputs=[(resh[0], resh[1], (1, 8)), (wm[0], wm[1], (8, 3))],
+                 out_shapes=((1, 3),), name="fc")
+    wb2 = b.const(bias2, "fc_b")
+    add2 = b.layer("Add", inputs=[(mm[0], mm[1], (1, 3)),
+                                  (wb2[0], wb2[1], (1, 3))],
+                   out_shapes=((1, 3),), name="fc_bias")
+    sm = b.layer("SoftMax", {"axis": "1"},
+                 inputs=[(add2[0], add2[1], (1, 3))],
+                 out_shapes=((1, 3),), name="probs")
+    last = (sm[0], sm[1], (1, 3))
+    if out_4d:
+        # OMZ classifiers emit [1, C, 1, 1] — trailing unit spatial dims
+        axes = b.const(np.asarray([2, 3], np.int64), "unsq_axes")
+        unsq = b.layer("Unsqueeze",
+                       inputs=[last, (*axes, (2,))],
+                       out_shapes=((1, 3, 1, 1),), name="probs4d")
+        last = (unsq[0], unsq[1], (1, 3, 1, 1))
+    b.result(last)
+    xml = b.write(tmp_path)
+    return xml, dict(conv_w=conv_w, bias=bias, mm_w=mm_w, bias2=bias2)
+
+
+def _golden_classifier(x: np.ndarray, w) -> np.ndarray:
+    """Independent numpy forward of the classifier fixture."""
+    padded = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((1, 2, 4, 4), np.float32)
+    for o in range(2):
+        for i_ in range(4):
+            for j in range(4):
+                conv[0, o, i_, j] = np.sum(
+                    padded[0, 0, i_:i_ + 3, j:j + 3] * w["conv_w"][o, 0]
+                )
+    conv = conv + w["bias"]
+    relu = np.maximum(conv, 0.0)
+    pool = relu.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    flat = pool.reshape(1, 8)
+    logits = flat @ w["mm_w"] + w["bias2"]
+    e = np.exp(logits - logits.max())
+    return e / e.sum()
+
+
+def test_classifier_ir_numeric_fidelity(tmp_path):
+    xml, weights = _build_classifier_ir(tmp_path)
+    model = load_ir(xml)
+    assert not model.is_detector
+    assert model.output_names == ["probs"]
+    assert model.output_is_prob == [True]
+    assert set(model.params) == {"conv_w", "conv_b", "fc_w", "fc_b"}
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 1, 4, 4)).astype(np.float32)
+    out = model.forward(model.params, x)
+    got = np.asarray(out["probs"])
+    want = _golden_classifier(x, weights)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_maxpool_ceil_rounding(tmp_path):
+    """ceil-mode pooling pads the tail window (5→3 outputs at k2 s2)."""
+    b = IRBuilder("poolnet")
+    x = b.layer("Parameter", {"shape": "1,1,5,5", "element_type": "f32"},
+                out_shapes=((1, 1, 5, 5),), name="input")
+    pool = b.layer(
+        "MaxPool",
+        {"kernel": "2,2", "strides": "2,2", "pads_begin": "0,0",
+         "pads_end": "0,0", "rounding_type": "ceil"},
+        inputs=[(x[0], x[1], (1, 1, 5, 5))],
+        out_shapes=((1, 1, 3, 3),), name="pool",
+    )
+    b.result((pool[0], pool[1], (1, 1, 3, 3)))
+    model = load_ir(b.write(tmp_path))
+    xv = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    out = np.asarray(model.forward(model.params, xv)["pool"])
+    want = np.asarray([[6, 8, 9], [16, 18, 19], [21, 23, 24]], np.float32)
+    np.testing.assert_allclose(out.reshape(3, 3), want)
+
+
+def _build_ssd_ir(tmp_path: Path):
+    """Tiny SSD: conv/4 backbone → 1-anchor loc+conf heads →
+    DetectionOutput fed by a constant-folded PriorBoxClustered branch."""
+    rng = np.random.default_rng(7)
+    back_w = rng.normal(size=(8, 3, 4, 4)).astype(np.float32) * 0.1
+    loc_w = rng.normal(size=(4, 8, 1, 1)).astype(np.float32) * 0.1
+    conf_w = rng.normal(size=(2, 8, 1, 1)).astype(np.float32) * 0.1
+
+    b = IRBuilder("tiny_ssd")
+    x = b.layer("Parameter", {"shape": "1,3,8,8", "element_type": "f32"},
+                out_shapes=((1, 3, 8, 8),), name="input")
+    bw = b.const(back_w, "backbone_w")
+    feat = b.layer(
+        "Convolution",
+        {"strides": "4,4", "pads_begin": "0,0", "pads_end": "0,0",
+         "dilations": "1,1"},
+        inputs=[(x[0], x[1], (1, 3, 8, 8)), (bw[0], bw[1], back_w.shape)],
+        out_shapes=((1, 8, 2, 2),), name="backbone",
+    )
+    lw = b.const(loc_w, "loc_w")
+    loc = b.layer(
+        "Convolution",
+        {"strides": "1,1", "pads_begin": "0,0", "pads_end": "0,0",
+         "dilations": "1,1"},
+        inputs=[(feat[0], feat[1], (1, 8, 2, 2)), (lw[0], lw[1], loc_w.shape)],
+        out_shapes=((1, 4, 2, 2),), name="loc_head",
+    )
+    loc_t = b.layer(
+        "Transpose",
+        inputs=[(loc[0], loc[1], (1, 4, 2, 2)),
+                (*b.const(np.asarray([0, 2, 3, 1], np.int64), "loc_perm"), (4,))],
+        out_shapes=((1, 2, 2, 4),), name="loc_t",
+    )
+    loc_flat = b.layer(
+        "Reshape", {"special_zero": "false"},
+        inputs=[(loc_t[0], loc_t[1], (1, 2, 2, 4)),
+                (*b.const(np.asarray([1, 16], np.int64), "loc_tgt"), (2,))],
+        out_shapes=((1, 16),), name="loc_flat",
+    )
+    cw = b.const(conf_w, "conf_w")
+    conf = b.layer(
+        "Convolution",
+        {"strides": "1,1", "pads_begin": "0,0", "pads_end": "0,0",
+         "dilations": "1,1"},
+        inputs=[(feat[0], feat[1], (1, 8, 2, 2)), (cw[0], cw[1], conf_w.shape)],
+        out_shapes=((1, 2, 2, 2),), name="conf_head",
+    )
+    conf_t = b.layer(
+        "Transpose",
+        inputs=[(conf[0], conf[1], (1, 2, 2, 2)),
+                (*b.const(np.asarray([0, 2, 3, 1], np.int64), "conf_perm"), (4,))],
+        out_shapes=((1, 2, 2, 2),), name="conf_t",
+    )
+    conf_r = b.layer(
+        "Reshape", {"special_zero": "false"},
+        inputs=[(conf_t[0], conf_t[1], (1, 2, 2, 2)),
+                (*b.const(np.asarray([1, 4, 2], np.int64), "conf_tgt"), (3,))],
+        out_shapes=((1, 4, 2),), name="conf_reshape",
+    )
+    conf_sm = b.layer("SoftMax", {"axis": "2"},
+                      inputs=[(conf_r[0], conf_r[1], (1, 4, 2))],
+                      out_shapes=((1, 4, 2),), name="conf_softmax")
+    conf_flat = b.layer(
+        "Reshape", {"special_zero": "false"},
+        inputs=[(conf_sm[0], conf_sm[1], (1, 4, 2)),
+                (*b.const(np.asarray([1, 8], np.int64), "conf_ftgt"), (2,))],
+        out_shapes=((1, 8),), name="conf_flat",
+    )
+    # PriorBoxClustered over const shape inputs (constant-folds)
+    fs = b.const(np.asarray([2, 2], np.int64), "feat_shape")
+    ims = b.const(np.asarray([8, 8], np.int64), "img_shape")
+    priors = b.layer(
+        "PriorBoxClustered",
+        {"width": "4.0", "height": "4.0", "clip": "false",
+         "step": "4.0", "offset": "0.5", "variance": "0.1,0.1,0.2,0.2"},
+        inputs=[(fs[0], fs[1], (2,)), (ims[0], ims[1], (2,))],
+        out_shapes=((1, 2, 16),), name="priors",
+    )
+    det = b.layer(
+        "DetectionOutput",
+        {"num_classes": "2", "background_label_id": "0", "top_k": "4",
+         "keep_top_k": "4", "code_type": "caffe.PriorBoxParameter.CENTER_SIZE",
+         "share_location": "true", "nms_threshold": "0.45",
+         "confidence_threshold": "0.01", "variance_encoded_in_target": "false",
+         "normalized": "true"},
+        inputs=[(loc_flat[0], loc_flat[1], (1, 16)),
+                (conf_flat[0], conf_flat[1], (1, 8)),
+                (priors[0], priors[1], (1, 2, 16))],
+        out_shapes=((1, 1, 4, 7),), name="detection",
+    )
+    b.result((det[0], det[1], (1, 1, 4, 7)))
+    xml = b.write(tmp_path)
+    return xml, dict(back_w=back_w, loc_w=loc_w, conf_w=conf_w)
+
+
+def test_ssd_ir_cut_at_detection_output(tmp_path):
+    xml, weights = _build_ssd_ir(tmp_path)
+    model = load_ir(xml)
+    assert model.is_detector
+    assert model.num_classes == 2
+    np.testing.assert_allclose(model.variances, (0.1, 0.1, 0.2, 0.2), rtol=1e-6)
+    # PriorBoxClustered: 2x2 cells, one 4x4 box each, step 4, offset .5
+    # → centers (2,2) (6,2) (2,6) (6,6) on the 8x8 image, normalized.
+    want_anchors = np.asarray(
+        [
+            [0.25, 0.25, 0.5, 0.5],
+            [0.75, 0.25, 0.5, 0.5],
+            [0.25, 0.75, 0.5, 0.5],
+            [0.75, 0.75, 0.5, 0.5],
+        ],
+        np.float32,
+    )
+    np.testing.assert_allclose(model.anchors, want_anchors, atol=1e-6)
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(1, 3, 8, 8)).astype(np.float32)
+    out = model.forward(model.params, x)
+    loc, conf = np.asarray(out["loc"]), np.asarray(out["conf"])
+    assert loc.shape == (1, 16) and conf.shape == (1, 8)
+
+    # independent numpy: strided conv backbone + 1x1 heads
+    feat = np.zeros((8, 2, 2), np.float32)
+    for o in range(8):
+        for i_ in range(2):
+            for j in range(2):
+                feat[o, i_, j] = np.sum(
+                    x[0, :, i_ * 4:i_ * 4 + 4, j * 4:j * 4 + 4]
+                    * weights["back_w"][o]
+                )
+    loc_m = np.einsum("oc,chw->ohw", weights["loc_w"][:, :, 0, 0], feat)
+    want_loc = loc_m.transpose(1, 2, 0).reshape(1, 16)
+    np.testing.assert_allclose(loc, want_loc, rtol=1e-4, atol=1e-5)
+    conf_m = np.einsum("oc,chw->ohw", weights["conf_w"][:, :, 0, 0], feat)
+    logits = conf_m.transpose(1, 2, 0).reshape(4, 2)
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want_conf = (e / e.sum(axis=1, keepdims=True)).reshape(1, 8)
+    np.testing.assert_allclose(conf, want_conf, rtol=1e-4, atol=1e-5)
+    # in-graph softmax detected → the engine step must not re-softmax
+    assert dict(zip(model.output_names, model.output_is_prob))["conf"] is True
+
+
+def test_registry_serves_imported_ir(tmp_path):
+    """End-to-end: IR on disk under the reference layout → registry
+    load → fused detect step jitted and executed."""
+    import jax
+
+    from evam_tpu.engine import steps as step_builders
+    from evam_tpu.models.registry import ModelRegistry
+
+    target = tmp_path / "ir_det" / "1" / "FP32"
+    target.mkdir(parents=True)
+    xml, _ = _build_ssd_ir(target)
+
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    assert "ir_det/1" in reg.keys()
+    model = reg.get("ir_det/1")
+    assert model.ir is not None and model.anchors is not None
+    assert model.conf_is_prob
+    assert model.spec.input_size == (8, 8)
+
+    step = step_builders.build_detect_step(
+        model, max_detections=4, wire_format="bgr", score_threshold=0.0
+    )
+    frames = np.random.default_rng(0).integers(
+        0, 255, (2, 8, 8, 3), np.uint8
+    )
+    packed = np.asarray(jax.jit(step)(model.params, frames))
+    assert packed.shape == (2, 4, 7)
+    # boxes are normalized corners; valid flags in {0,1}
+    assert np.all(packed[..., :4] >= 0.0) and np.all(packed[..., :4] <= 1.0)
+    assert set(np.unique(packed[..., 6])) <= {0.0, 1.0}
+
+
+def test_registry_ir_classifier_4d_heads(tmp_path):
+    """OMZ classifiers emit [1,C,1,1]; head width must be prod of the
+    non-batch dims (not shape[-1] = 1) and forward must flatten to
+    [B, C] for the classify step."""
+    from evam_tpu.models.registry import ModelRegistry
+
+    target = tmp_path / "emotion" / "1" / "FP32"
+    target.mkdir(parents=True)
+    _build_classifier_ir(target, out_4d=True)
+
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    m = reg.get("emotion/1")
+    assert m.spec.heads == (("probs4d", 3),)
+    assert m.head_is_prob.get("probs4d") is True
+    x = np.zeros((2, 4, 4, 1), np.float32)  # NHWC engine convention
+    out = m.forward(m.params, x)
+    assert np.asarray(out["probs4d"]).shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(out["probs4d"]).sum(axis=-1), 1.0,
+                               rtol=1e-5)
+
+
+def test_fetch_models_from_ir(tmp_path):
+    from evam_tpu.models.fetch import import_ir_dir
+
+    src = tmp_path / "src"
+    src.mkdir()
+    _build_classifier_ir(src)
+    out = tmp_path / "models"
+    rc = import_ir_dir(src, out, alias="emotion", version="2",
+                       precision="FP32")
+    assert rc == 0
+    assert (out / "emotion" / "2" / "FP32" / "model.xml").exists()
+    assert (out / "emotion" / "2" / "FP32" / "model.bin").exists()

@@ -1,0 +1,104 @@
+"""Host ingest benchmark: decode-side per-frame cost (VERDICT item 5).
+
+Measures the host work a decode worker pays per 1080p frame before
+the wire upload — resize to the engine ingest resolution + BGR→I420
+wire encoding — via (a) the cv2/numpy fallback path and (b) the
+native OpenMP kernels (built on demand), then extrapolates to the
+64×1080p30 north star (1,920 frames/s of this work plus decode).
+
+Prints a small JSON report; the committed numbers live in INGEST.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(fn, frames, seconds=3.0) -> float:
+    """Returns frames/second of `fn` over rotating inputs."""
+    for f in frames[:2]:
+        fn(f)
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        fn(frames[n % len(frames)])
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    import cv2
+
+    from evam_tpu import native
+    from evam_tpu.ops.color import bgr_to_i420_host
+
+    rng = np.random.default_rng(0)
+    frames = [
+        rng.integers(0, 255, (1080, 1920, 3), np.uint8) for _ in range(4)
+    ]
+    target = (512, 512)  # flagship detect ingest (H, W)
+    cores = os.cpu_count() or 1
+    report: dict = {"cores": cores, "target": list(target)}
+
+    # cv2 path: resize then I420 encode (what decode workers do when
+    # the native library is absent)
+    def cv2_path(f):
+        r = cv2.resize(f, (target[1], target[0]))
+        return bgr_to_i420_host(r)
+
+    report["cv2_resize_i420_fps_1core"] = round(bench(cv2_path, frames), 1)
+
+    # native fused kernel (EVAM_NATIVE built on demand)
+    try:
+        native.build()
+    except Exception as exc:  # noqa: BLE001
+        report["native_error"] = str(exc)
+    if native.available():
+        def native_path(f):
+            return native.resize_bgr_to_i420(f, target[0], target[1])
+
+        report["native_fused_fps_1core"] = round(
+            bench(native_path, frames), 1)
+
+    # decode benchmark: cv2 VideoCapture over a generated clip
+    clip = "/tmp/ingest_bench.avi"
+    if not os.path.exists(clip):
+        w = cv2.VideoWriter(
+            clip, cv2.VideoWriter_fourcc(*"MJPG"), 30, (1920, 1080))
+        for f in frames * 8:
+            w.write(f)
+        w.release()
+    cap = cv2.VideoCapture(clip)
+    n, t0 = 0, time.perf_counter()
+    while True:
+        ok, _ = cap.read()
+        if not ok:
+            break
+        n += 1
+    decode_fps = n / (time.perf_counter() - t0)
+    cap.release()
+    report["cv2_mjpeg_decode_fps_1core"] = round(decode_fps, 1)
+
+    # extrapolation to the 64-stream north star
+    need = 64 * 30
+    best_prep = max(
+        report.get("native_fused_fps_1core", 0),
+        report["cv2_resize_i420_fps_1core"],
+    )
+    per_frame_s = 1.0 / best_prep + 1.0 / decode_fps
+    report["northstar_frames_per_s"] = need
+    report["est_cores_for_64x1080p30"] = round(need * per_frame_s, 1)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
