@@ -1,0 +1,248 @@
+"""Golden route/flow contract tests (round-1 VERDICT item 10).
+
+Pins the exact JSON shape (keys, enum values, value types) of every
+REST route and of the published metadata messages against committed
+golden files in tests/golden/, so contract drift against the
+reference's documented flows (reference charts/templates/
+NOTES.txt:7-21 request flow, charts/README.md:117-119 sample
+metadata, evas/publisher.py:183-230 EII message) is caught
+mechanically.
+
+Bodies are canonicalized — numbers/uuids/free strings become typed
+placeholders, keys and enum-ish strings stay literal — so the goldens
+pin structure and vocabulary, not float noise. Regenerate with
+GOLDEN_UPDATE=1 after an intentional contract change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from evam_tpu.config.settings import Settings
+from evam_tpu.engine import EngineHub
+from evam_tpu.models import ModelRegistry, ZOO_SPECS
+from evam_tpu.parallel import build_mesh
+from evam_tpu.server.app import build_app
+from evam_tpu.server.registry import PipelineRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN_DIR = Path(__file__).resolve().parent / "golden"
+SMALL = {k: (64, 64) for k in ZOO_SPECS}
+SMALL["audio_detection/environment"] = (1, 1600)
+NARROW = {k: 8 for k in ZOO_SPECS}
+
+_UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$"
+)
+#: strings kept literal in goldens: states, labels, formats, schema-ish
+_ENUM_RE = re.compile(r"^[A-Za-z0-9_\-/. :=,]{1,64}$")
+
+
+def canonical(obj):
+    """Shape-preserving canonical form: keys + enum strings literal,
+    volatile values to typed placeholders."""
+    if isinstance(obj, dict):
+        return {k: canonical(obj[k]) for k in sorted(obj)}
+    if isinstance(obj, list):
+        # pin the element shape (first element) + the fact it's a list
+        return [canonical(obj[0])] if obj else []
+    if isinstance(obj, bool):
+        return "<bool>"
+    if isinstance(obj, (int, float)):
+        return "<num>"
+    if isinstance(obj, str):
+        if _UUID_RE.match(obj):
+            return "<uuid>"
+        if _ENUM_RE.match(obj):
+            return obj
+        return "<str>"
+    if obj is None:
+        return None
+    return f"<{type(obj).__name__}>"
+
+
+def check_golden(name: str, got) -> None:
+    path = GOLDEN_DIR / f"{name}.json"
+    canon = canonical(got)
+    if os.environ.get("GOLDEN_UPDATE") or not path.exists():
+        GOLDEN_DIR.mkdir(exist_ok=True)
+        path.write_text(json.dumps(canon, indent=2, sort_keys=True) + "\n")
+        if os.environ.get("GOLDEN_UPDATE"):
+            return
+    want = json.loads(path.read_text())
+    assert canon == want, (
+        f"contract drift vs tests/golden/{name}.json —\n"
+        f"got: {json.dumps(canon, indent=2, sort_keys=True)}"
+    )
+
+
+@pytest.fixture(scope="module")
+def registry(eight_devices, tmp_path_factory):
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    model_registry = ModelRegistry(dtype="float32", input_overrides=SMALL,
+                                   width_overrides=NARROW)
+    hub = EngineHub(model_registry, plan=build_mesh(), max_batch=16,
+                    deadline_ms=4.0)
+    reg = PipelineRegistry(settings, hub=hub)
+    yield reg
+    reg.stop_all()
+
+
+def _request(registry, method, path, body=None):
+    async def go():
+        app = build_app(registry)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.request(method, path, json=body)
+            try:
+                data = await resp.json()
+            except Exception:
+                data = await resp.text()
+            return resp.status, data
+
+    return asyncio.run(go())
+
+
+def _wait_done(registry, iid, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        inst = registry.get_instance(iid)
+        if inst is not None and inst.status()["state"] in (
+            "COMPLETED", "ERROR", "ABORTED",
+        ):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"instance {iid} did not finish")
+
+
+class TestRestRouteContracts:
+    """One golden per route of the reference REST surface
+    (charts/templates/NOTES.txt:7-21 + TPU-native additions)."""
+
+    def test_list_pipelines(self, registry):
+        status, data = _request(registry, "GET", "/pipelines")
+        assert status == 200
+        check_golden("route_get_pipelines", data)
+
+    def test_describe_pipeline(self, registry):
+        status, data = _request(
+            registry, "GET", "/pipelines/object_detection/person_vehicle_bike")
+        assert status == 200
+        check_golden("route_describe_pipeline", data)
+
+    def test_start_status_delete_flow(self, registry, tmp_path):
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=6", "type": "uri"},
+            "destination": {"metadata": {
+                "type": "file", "path": str(tmp_path / "out.jsonl")}},
+        }
+        status, iid = _request(
+            registry, "POST",
+            "/pipelines/object_detection/person_vehicle_bike", body)
+        assert status == 200
+        # reference returns the bare instance id on POST
+        check_golden("route_post_start", iid)
+
+        status, summary = _request(
+            registry, "GET",
+            f"/pipelines/object_detection/person_vehicle_bike/{iid}")
+        assert status == 200
+        check_golden("route_instance_summary", summary)
+
+        status, st = _request(
+            registry, "GET",
+            f"/pipelines/object_detection/person_vehicle_bike/{iid}/status")
+        assert status == 200
+        assert st["state"] in ("QUEUED", "RUNNING", "COMPLETED")
+        check_golden("route_instance_status", st)
+
+        _wait_done(registry, iid)
+        status, stopped = _request(
+            registry, "DELETE",
+            f"/pipelines/object_detection/person_vehicle_bike/{iid}")
+        assert status == 200
+        check_golden("route_delete_instance", stopped)
+
+        status, all_st = _request(registry, "GET", "/pipelines/status")
+        assert status == 200
+        check_golden("route_all_statuses", all_st)
+
+    def test_models_engines_healthz(self, registry):
+        status, models = _request(registry, "GET", "/models")
+        assert status == 200
+        check_golden("route_get_models", models)
+        status, health = _request(registry, "GET", "/healthz")
+        assert status == 200
+        check_golden("route_healthz", health)
+
+    def test_error_contracts(self, registry):
+        status, data = _request(
+            registry, "GET", "/pipelines/object_detection/nope")
+        assert status == 404
+        check_golden("route_404_pipeline", data)
+        status, data = _request(
+            registry, "POST", "/pipelines/object_detection/person_vehicle_bike",
+            {"destination": {}})
+        assert status == 400
+        check_golden("route_400_bad_request", data)
+        status, data = _request(
+            registry, "GET",
+            "/pipelines/object_detection/person_vehicle_bike/no-such-id/status")
+        assert status == 404
+        check_golden("route_404_instance", data)
+
+
+class TestPublishedMetadataContracts:
+    def test_eva_metadata_message(self, registry, tmp_path):
+        """The §6 metadata schema every EVA-mode consumer parses
+        (reference charts/README.md:117-119 sample)."""
+        out = tmp_path / "meta.jsonl"
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=4", "type": "uri"},
+            "destination": {"metadata": {"type": "file", "path": str(out)}},
+            "parameters": {"detection-properties": {"threshold": 0.0}},
+        }
+        status, iid = _request(
+            registry, "POST",
+            "/pipelines/object_detection/person_vehicle_bike", body)
+        assert status == 200
+        _wait_done(registry, iid)
+        lines = [json.loads(l) for l in out.read_text().splitlines() if l]
+        assert lines
+        with_objects = [m for m in lines if m.get("objects")]
+        assert with_objects, "threshold 0 must yield detections"
+        check_golden("message_eva_metadata", with_objects[0])
+
+    def test_eii_msgbus_message(self, registry):
+        """EII-mode (meta, blob) message shape (reference
+        evas/publisher.py:183-230: img_handle/caps/gva_meta)."""
+        from evam_tpu.stages.context import FrameContext, Region, Tensor
+
+        from evam_tpu.eii.manager import _gva_meta
+
+        ctx = FrameContext(
+            frame=np.zeros((64, 96, 3), np.uint8), pts_ns=123, seq=1,
+            stream_id="cam1",
+        )
+        region = Region(0.1, 0.2, 0.5, 0.8, 0.9, 1, "person")
+        region.object_id = 7
+        region.tensors.append(
+            Tensor(name="color", confidence=0.8, label_id=2, label="white"))
+        ctx.regions = [region]
+        meta = {
+            "img_handle": "a1b2c3d4e5f6",
+            "width": 96,
+            "height": 64,
+            "channels": 3,
+            "caps": "video/x-raw, format=BGR, width=96, height=64",
+            "gva_meta": _gva_meta(ctx),
+        }
+        check_golden("message_eii_msgbus", meta)
