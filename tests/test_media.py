@@ -46,7 +46,35 @@ def test_create_source_types():
     )
     assert isinstance(create_source({"type": "application"}), AppSource)
     with pytest.raises(ValueError):
-        create_source({"type": "gige"})
+        create_source({"type": "holographic"})
+
+
+def test_gige_source_contract():
+    """source.type 'gige' resolves (reference {auto_source}→gencamsrc)
+    and fails with an actionable error when no GenTL/GStreamer backend
+    exists (none in this image)."""
+    from evam_tpu.media.source import GigeSource, gige_frame_to_bgr
+
+    src = create_source({"type": "gige", "serial": "cam-042",
+                         "pixel-format": "Mono8"})
+    assert isinstance(src, GigeSource)
+    assert src.serial == "cam-042"
+    with pytest.raises(RuntimeError, match="GenTL|GStreamer"):
+        next(src.frames())
+    src.close()
+
+    # pixel-format conversion is pure and testable without hardware
+    mono = np.full((8, 8), 200, np.uint8)
+    bgr = gige_frame_to_bgr(mono, "Mono8")
+    assert bgr.shape == (8, 8, 3) and bgr[0, 0, 0] == 200
+    bayer = np.zeros((8, 8), np.uint8)
+    assert gige_frame_to_bgr(bayer, "BayerRG8").shape == (8, 8, 3)
+    rgb = np.zeros((4, 4, 3), np.uint8)
+    rgb[..., 0] = 255  # R plane
+    out = gige_frame_to_bgr(rgb, "RGB8")
+    assert out[0, 0, 2] == 255 and out[0, 0, 0] == 0  # channel swap
+    with pytest.raises(ValueError):
+        gige_frame_to_bgr(mono, "Packed10")
 
 
 def test_decode_worker_queue_and_eos():

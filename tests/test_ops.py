@@ -98,6 +98,30 @@ def test_nms_sequential_semantics():
     assert int(valid.sum()) == 2  # a and c survive
 
 
+def test_nms_settle_modes_agree(monkeypatch):
+    """The unrolled Jacobi settle (TPU scheduling win) must match the
+    convergence-checked while_loop on a suppression chain: a kills b,
+    b would kill c (but is dead, so c lives), c kills d."""
+    from evam_tpu.ops import nms as nms_mod
+
+    boxes = jnp.asarray([
+        [0.00, 0.0, 0.40, 0.4],
+        [0.10, 0.1, 0.50, 0.5],
+        [0.20, 0.2, 0.60, 0.6],
+        [0.30, 0.3, 0.70, 0.7],
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    labels = jnp.ones(4, jnp.int32)
+    results = {}
+    for mode in ("while", "unroll"):
+        monkeypatch.setattr(nms_mod, "SETTLE", mode)
+        out = nms_mod.nms_single(boxes, scores, labels, 4, iou_threshold=0.3)
+        results[mode] = [np.asarray(x) for x in out]
+    for a, b in zip(results["while"], results["unroll"]):
+        np.testing.assert_array_equal(a, b)
+    assert int(results["while"][3].sum()) == 2  # a and c survive
+
+
 def test_batched_nms_shapes_and_background():
     b, a, c = 3, 50, 4
     rng = np.random.default_rng(2)
