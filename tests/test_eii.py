@@ -145,6 +145,70 @@ class TestEtcdConfigMgr:
         finally:
             gw.close()
 
+    def test_manager_boots_and_hot_reloads_from_etcd(
+            self, registry, tmp_path):
+        """EII mode end-to-end on the etcd control plane: boot config
+        from the gateway, hot-reload the pipeline on an etcd write
+        (reference flow: evas/__main__.py:34 ConfigMgr → etcd,
+        eii/docker-compose.yml:44-47)."""
+        from evam_tpu.eii.configmgr import EtcdGatewayStore
+        from evam_tpu.eii.manager import EiiManager
+
+        gw = _FakeEtcdGateway()
+        try:
+            gw.put("/evam_tpu/config", {
+                "source": "gstreamer",
+                "pipeline": "video_decode/app_dst",
+                "source_parameters": {
+                    "type": "uri",
+                    "uri": "synthetic://64x48@30?count=1000",
+                },
+                "publish_frame": False,
+            })
+            gw.put("/evam_tpu/interfaces", {
+                "Publishers": [{
+                    "Name": "default", "Type": "zmq_ipc",
+                    "EndPoint": str(tmp_path / "socks"),
+                    "Topics": ["results"], "AllowedClients": ["*"],
+                }],
+                "Subscribers": [],
+            })
+            cfg = ConfigMgr(
+                etcd=EtcdGatewayStore("127.0.0.1", port=gw.port),
+                watch_interval_s=0.1,
+            )
+            mgr = EiiManager(
+                Settings(pipelines_dir=str(REPO / "pipelines")),
+                cfg_mgr=cfg, registry=registry,
+            )
+            try:
+                first = mgr.instance
+                assert first is not None
+                assert first.pipeline_name == "video_decode"
+
+                # etcd write → watcher → pipeline restart on new config
+                gw.put("/evam_tpu/config", {
+                    "source": "gstreamer",
+                    "pipeline": "video_decode/app_dst",
+                    "source_parameters": {
+                        "type": "uri",
+                        "uri": "synthetic://32x32@30?count=1000",
+                    },
+                    "publish_frame": False,
+                })
+                deadline = time.time() + 20
+                while mgr.instance is first and time.time() < deadline:
+                    time.sleep(0.05)
+                assert mgr.instance is not first, "hot reload never fired"
+                assert mgr.reload_error is None
+            finally:
+                mgr._stop.set()
+                cfg.close()
+                if mgr.instance is not None:
+                    mgr.registry.stop_instance(mgr.instance.id)
+        finally:
+            gw.close()
+
     def test_dead_gateway_falls_back_to_file(self, tmp_path):
         from evam_tpu.eii.configmgr import EtcdGatewayStore
 
