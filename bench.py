@@ -115,6 +115,9 @@ def main() -> int:
     p.add_argument("--probe-timeout", type=float, default=150.0,
                    help="seconds to wait for the device-probe subprocess")
     p.add_argument("--skip-probe", action="store_true")
+    p.add_argument("--precision", choices=["bf16", "int8"], default="bf16",
+                   help="int8: quantized module variants on the int8 MXU "
+                   "path (weights stay float; ops/qlinear.py)")
     p.add_argument("--sweep", action="store_true",
                    help="measure several (batch, depth) operating points "
                    "and report the best meeting --p99-target (tuning "
@@ -150,7 +153,8 @@ def main() -> int:
     dev = jax.devices()[0]
     log(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}")
 
-    registry = ModelRegistry()
+    registry = ModelRegistry(
+        dtype="int8" if args.precision == "int8" else "bfloat16")
     b, h, w = args.batch, args.height, args.width
     if args.config == "detect_classify":
         det = registry.get("object_detection/person_vehicle_bike")

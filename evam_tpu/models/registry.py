@@ -189,10 +189,13 @@ class LoadedModel:
 def build_module(spec: ModelSpec, overrides: dict[str, Any] | None = None):
     cfg = dict(overrides or {})
     width = cfg.get("width", spec.width)
+    quant = bool(cfg.get("quant", False))
     if spec.family == "ssd":
-        return SSDDetector(num_classes=spec.num_classes, width=width)
+        return SSDDetector(num_classes=spec.num_classes, width=width,
+                           quant=quant)
     if spec.family == "classifier":
-        return MultiHeadClassifier(heads=spec.heads, width=width)
+        return MultiHeadClassifier(heads=spec.heads, width=width,
+                                   quant=quant)
     if spec.family == "action_encoder":
         return ActionEncoder(width=width)
     if spec.family == "action_decoder":
@@ -249,6 +252,12 @@ class ModelRegistry:
         width_overrides: dict[str, int] | None = None,
     ):
         self.models_dir = Path(models_dir) if models_dir else None
+        # EVAM_PRECISION=int8 selects the quantized serving path in
+        # one knob: int8 module variants computing over bf16 tensors
+        # between layers, float weights on disk
+        if dtype.lower() in ("int8", "fp32-int8", "fp16-int8", "bf16-int8"):
+            precision = "INT8"
+            dtype = "bfloat16"
         self.precision = precision
         self.dtype = dtype
         self.input_overrides = input_overrides or {}
@@ -272,6 +281,13 @@ class ModelRegistry:
     def _load(self, key: str) -> LoadedModel:
         ir_xml = self._ir_xml_path(key)
         if ir_xml is not None:
+            if "INT8" in self.precision.upper():
+                log.warning(
+                    "%s: INT8 precision requested but the model is "
+                    "IR-backed — the IR executor runs the float path "
+                    "(quantized variants exist for zoo modules only)",
+                    key,
+                )
             return self._load_ir(key, ir_xml)
         spec = ZOO_SPECS.get(key)
         if spec is None:
@@ -284,7 +300,12 @@ class ModelRegistry:
         if key in self.width_overrides:
             spec = ModelSpec(**{**spec.__dict__, "width": self.width_overrides[key]})
 
-        module = build_module(spec)
+        # INT8-class precisions select the quantized module variant
+        # (same checkpoint pytree — FP weights serve under INT8; the
+        # reference schema's INT8 / FP16-INT8 / FP32-INT8 deployment
+        # precisions, mdt_schema.py:17-22)
+        module = build_module(
+            spec, {"quant": "INT8" in self.precision.upper()})
         params = self._init_or_load_params(spec, module)
 
         proc = self._find_model_proc(spec)
@@ -323,7 +344,7 @@ class ModelRegistry:
         if not self.models_dir:
             return None
         base = self.models_dir / key
-        for precision in (self.precision, "FP32", "FP16"):
+        for precision in (self.precision, "BF16", "FP32", "FP16"):
             hits = sorted((base / precision).glob("*.xml"))
             if hits:
                 return hits[0]
@@ -413,7 +434,7 @@ class ModelRegistry:
         if not self.models_dir:
             return None
         base = self.models_dir / spec.key
-        for precision in (self.precision, "FP32", "FP16"):
+        for precision in (self.precision, "BF16", "FP32", "FP16"):
             p = base / precision / "weights.msgpack"
             if p.exists():
                 return p

@@ -23,13 +23,15 @@ class MultiHeadClassifier(nn.Module):
 
     heads: tuple[tuple[str, int], ...]
     width: int = 32
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
         w = self.width
-        x = ConvBlock(w, strides=(2, 2))(x)
-        x = SeparableConv(w * 2, strides=(2, 2))(x)
-        x = SeparableConv(w * 4, strides=(2, 2))(x)
-        x = SeparableConv(w * 8, strides=(2, 2))(x)
+        q = self.quant
+        x = ConvBlock(w, strides=(2, 2), quant=q)(x)
+        x = SeparableConv(w * 2, strides=(2, 2), quant=q)(x)
+        x = SeparableConv(w * 4, strides=(2, 2), quant=q)(x)
+        x = SeparableConv(w * 8, strides=(2, 2), quant=q)(x)
         x = x.mean(axis=(1, 2))  # global average pool
         return {name: nn.Dense(n)(x) for name, n in self.heads}

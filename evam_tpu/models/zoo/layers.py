@@ -14,16 +14,64 @@ from collections.abc import Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
+from evam_tpu.ops.qlinear import quant_conv
+
+
+class QuantConv(nn.Module):
+    """Drop-in nn.Conv replacement running on the int8 MXU path.
+
+    Same param names/shapes as nn.Conv ("kernel" HWIO + "bias"), so a
+    module tree that swaps nn.Conv ↔ QuantConv keeps an identical
+    checkpoint pytree — FP32/BF16 weights serve unchanged under INT8
+    (quantization happens in-jit; see ops/qlinear.py).
+    """
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    feature_group_count: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        in_ch = x.shape[-1] // self.feature_group_count
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, in_ch, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return quant_conv(
+            x, kernel, bias, strides=self.strides, padding="SAME",
+            feature_group_count=self.feature_group_count,
+        ).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
+
+
+def _conv(quant: bool, features, kernel_size, strides=(1, 1), groups=1,
+          name=None):
+    """nn.Conv or QuantConv with matching param trees. Explicit names
+    keep the pytree identical across the quant flag."""
+    if quant:
+        return QuantConv(
+            features, kernel_size, strides,
+            feature_group_count=groups, name=name,
+        )
+    return nn.Conv(
+        features, kernel_size, strides, padding="SAME",
+        feature_group_count=groups, name=name,
+    )
+
 
 class ConvBlock(nn.Module):
     features: int
     kernel: tuple[int, int] = (3, 3)
     strides: tuple[int, int] = (1, 1)
     act: Callable = nn.relu6
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, self.kernel, self.strides, padding="SAME")(x)
+        x = _conv(self.quant, self.features, self.kernel, self.strides,
+                  name="Conv_0")(x)
         return self.act(x)
 
 
@@ -33,19 +81,24 @@ class SeparableConv(nn.Module):
     features: int
     strides: tuple[int, int] = (1, 1)
     act: Callable = nn.relu6
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
         in_ch = x.shape[-1]
+        # depthwise stays float: grouped int8 conv with group size 1
+        # has no MXU win (it's VPU-bound either way) and costs an
+        # extra quant/dequant round-trip
         x = nn.Conv(
             in_ch,
             (3, 3),
             self.strides,
             padding="SAME",
             feature_group_count=in_ch,
+            name="Conv_0",
         )(x)
         x = self.act(x)
-        x = nn.Conv(self.features, (1, 1), padding="SAME")(x)
+        x = _conv(self.quant, self.features, (1, 1), name="Conv_1")(x)
         return self.act(x)
 
 
@@ -80,26 +133,30 @@ class Backbone(nn.Module):
 
     Returns feature maps at strides /8, /16, /32 (+ extra /64, /128
     levels when ``extra_levels`` > 0) — the standard SSD pyramid.
+    ``quant=True`` runs the pointwise (MXU-bound) convs on the int8
+    path; the checkpoint pytree is unchanged.
     """
 
     width: int = 32
     extra_levels: int = 2
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x) -> list[jnp.ndarray]:
         w = self.width
-        x = ConvBlock(w, strides=(2, 2))(x)            # /2
-        x = SeparableConv(w * 2, strides=(2, 2))(x)    # /4
-        x = SeparableConv(w * 2)(x)
-        x = SeparableConv(w * 4, strides=(2, 2))(x)    # /8
-        c3 = SeparableConv(w * 4)(x)
-        x = SeparableConv(w * 8, strides=(2, 2))(c3)   # /16
-        c4 = SeparableConv(w * 8)(x)
-        x = SeparableConv(w * 16, strides=(2, 2))(c4)  # /32
-        c5 = SeparableConv(w * 16)(x)
+        q = self.quant
+        x = ConvBlock(w, strides=(2, 2), quant=q)(x)            # /2
+        x = SeparableConv(w * 2, strides=(2, 2), quant=q)(x)    # /4
+        x = SeparableConv(w * 2, quant=q)(x)
+        x = SeparableConv(w * 4, strides=(2, 2), quant=q)(x)    # /8
+        c3 = SeparableConv(w * 4, quant=q)(x)
+        x = SeparableConv(w * 8, strides=(2, 2), quant=q)(c3)   # /16
+        c4 = SeparableConv(w * 8, quant=q)(x)
+        x = SeparableConv(w * 16, strides=(2, 2), quant=q)(c4)  # /32
+        c5 = SeparableConv(w * 16, quant=q)(x)
         feats = [c3, c4, c5]
         for _ in range(self.extra_levels):
-            x = ConvBlock(w * 8, kernel=(1, 1))(feats[-1])
-            x = ConvBlock(w * 16, strides=(2, 2))(x)
+            x = ConvBlock(w * 8, kernel=(1, 1), quant=q)(feats[-1])
+            x = ConvBlock(w * 16, strides=(2, 2), quant=q)(x)
             feats.append(x)
         return feats

@@ -46,10 +46,13 @@ class SSDDetector(nn.Module):
     width: int = 32
     extra_levels: int = 2
     aspect_ratios: tuple[float, ...] = (1.0, 2.0, 0.5)
+    #: int8 MXU path for the backbone (heads stay float — tiny and
+    #: accuracy-sensitive); checkpoint pytree unchanged
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
-        feats = Backbone(self.width, self.extra_levels)(x)
+        feats = Backbone(self.width, self.extra_levels, quant=self.quant)(x)
         num_anchors = anchors_per_cell(self.aspect_ratios)
         locs, confs = [], []
         for feat in feats:

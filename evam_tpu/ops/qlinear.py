@@ -1,0 +1,91 @@
+"""INT8 quantized conv/dense primitives for the serving path.
+
+TPU v5e's MXU runs int8×int8→int32 at twice the bf16 rate; the
+reference's model schema ships INT8 precisions for exactly this class
+of deployment (reference tools/model_downloader/mdt_schema.py:17-22
+allows INT8 / FP16-INT8 / FP32-INT8). Scheme:
+
+* **weights**: symmetric per-output-channel int8, quantized in-jit
+  from the float params (`round(w / w_scale)`); params stay float on
+  disk so FP32/BF16 checkpoints load unchanged and XLA folds the
+  quantization of the (small) weight tensors into the step;
+* **activations**: symmetric per-tensor dynamic int8 — one abs-max
+  reduction per layer, then the conv runs on the int8 MXU path via
+  ``preferred_element_type=int32``;
+* bias add + activation stay float (accuracy-sensitive, bandwidth-
+  trivial).
+
+This is dynamic post-training quantization: no calibration pass, no
+quantized checkpoint format, ~0.5–2% typical top-1 cost on convnets.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def quantize_weight(kernel: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Float kernel [kh, kw, in, out] → (int8 kernel, per-out-channel
+    scale [out])."""
+    w = kernel.astype(jnp.float32)
+    w_scale = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1))) / 127.0
+    w_scale = jnp.maximum(w_scale, 1e-8)
+    wq = jnp.clip(jnp.round(w / w_scale), -127, 127).astype(jnp.int8)
+    return wq, w_scale
+
+
+def quantize_act(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Float activations → (int8 tensor, per-example scale).
+
+    The scale reduces over every non-batch axis: frames from
+    different streams share engine batches, so a per-batch scale
+    would make one frame's quantization depend on whatever co-batched
+    with it (batch-composition-dependent outputs)."""
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, xf.ndim))
+    x_scale = jnp.maximum(
+        jnp.max(jnp.abs(xf), axis=axes, keepdims=True) / 127.0, 1e-8)
+    xq = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+    return xq, x_scale
+
+
+def quant_conv(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: jnp.ndarray | None,
+    strides: tuple[int, int] = (1, 1),
+    padding: str = "SAME",
+    feature_group_count: int = 1,
+) -> jnp.ndarray:
+    """INT8 convolution with float in/out (NHWC / HWIO)."""
+    wq, w_scale = quantize_weight(kernel)
+    xq, x_scale = quantize_act(x)
+    y = lax.conv_general_dilated(
+        xq, wq,
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.int32,
+    )
+    out = y.astype(jnp.float32) * (x_scale * w_scale)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out
+
+
+def quant_dense(
+    x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None
+) -> jnp.ndarray:
+    """INT8 matmul with float in/out (kernel [in, out])."""
+    wq, w_scale = quantize_weight(kernel)
+    xq, x_scale = quantize_act(x)
+    y = lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = y.astype(jnp.float32) * (x_scale * w_scale)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out
