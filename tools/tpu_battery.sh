@@ -1,0 +1,42 @@
+#!/bin/bash
+# Unattended TPU measurement battery — run the moment the axon tunnel
+# answers a probe (it wedges unpredictably; front-load everything).
+# Results land in /tmp/tpu_battery/ as JSON lines + logs, feeding
+# PROFILE.md's after-tables and the bench operating-point choice.
+set -u
+OUT=${1:-/tmp/tpu_battery}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() {
+    name=$1; shift
+    echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+    timeout 900 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+    echo "rc=$? $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+}
+
+# 1. cheapest first: one clean headline number at the current default
+run bench_default python bench.py --seconds 8
+
+# 2. cumulative phase ladder (where did the fused-step time go)
+run profile python tools/profile_step.py
+
+# 3. operating-point sweep under the latency target
+run bench_sweep python bench.py --sweep --seconds 25 --p99-target-ms 100
+
+# 4. int8 vs bf16 A/B at the sweep's shape (fixed 16x2 if unknown)
+run bench_int8 python bench.py --precision int8 --batch 16 --depth 2 --seconds 8
+run bench_bf16 python bench.py --batch 16 --depth 2 --seconds 8
+
+# 5. NMS settle A/B
+EVAM_NMS=unroll run bench_nms_unroll python bench.py --config detect --seconds 6 || true
+run bench_nms_while python bench.py --config detect --seconds 6
+
+# 6. secondary configs for BASELINE coverage
+run bench_action python bench.py --config action --seconds 6
+run bench_audio python bench.py --config audio --seconds 6
+
+# 7. host-ingest path (true PCIe/tunnel transfer)
+run bench_host python bench.py --ingest host --batch 8 --depth 2 --seconds 6
+
+echo "battery complete -> $OUT" | tee -a "$OUT/battery.log"
