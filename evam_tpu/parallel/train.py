@@ -128,6 +128,10 @@ class ActionTrainConfig:
     #: > 0 enables the mixture-of-experts decoder MLP (expert
     #: parallelism over the model axis, evam_tpu.parallel.moe)
     moe_experts: int = 0
+    #: sequence-parallel attention strategy: "ring" (K/V ring over
+    #: ppermute, scales past head count) or "ulysses" (all-to-all
+    #: head exchange, fewer larger transfers; needs heads % seq == 0)
+    sp_strategy: str = "ring"
 
 
 @dataclasses.dataclass
@@ -205,9 +209,20 @@ def build_action_trainer(
         jax.lax.with_sharding_constraint,
         shardings=NamedSharding(mesh, P("data", "seq", "model")),
     )
-    attention_fn = make_flax_attention_fn(
-        mesh, seq_axis="seq", batch_axis="data", head_axis="model"
-    )
+    if cfg.sp_strategy == "ulysses":
+        from evam_tpu.parallel.ulysses import (
+            make_flax_attention_fn as make_ulysses_fn,
+        )
+
+        attention_fn = make_ulysses_fn(
+            mesh, seq_axis="seq", batch_axis="data", head_axis="model"
+        )
+    elif cfg.sp_strategy == "ring":
+        attention_fn = make_flax_attention_fn(
+            mesh, seq_axis="seq", batch_axis="data", head_axis="model"
+        )
+    else:
+        raise ValueError(f"unknown sp_strategy {cfg.sp_strategy!r}")
     moe_constraint = functools.partial(
         jax.lax.with_sharding_constraint,
         shardings=NamedSharding(mesh, P("data", "seq", "model", None)),

@@ -1,0 +1,136 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two standard long-context strategies (alongside
+ring attention, evam_tpu.parallel.ring): instead of rotating K/V
+blocks around a ring, one ``all_to_all`` re-shards the tensors from
+sequence-sharded [B, T/n, H, D] to head-sharded [B, T, H/n, D], full
+attention runs locally per head subset (heads are independent), and a
+second ``all_to_all`` restores sequence sharding.
+
+Trade-off vs the ring (why both exist):
+
+* Ulysses moves Q, K and V **once** each way (2 collective phases)
+  and then computes dense local attention — fewer, larger transfers
+  that ride ICI bisection bandwidth; but it caps the sequence-shard
+  count at the head count (n must divide H).
+* The ring never re-shards Q and overlaps its n-1 K/V hops with
+  compute, scales past the head count, and keeps O(T/n) memory for
+  scores; but it serializes n matmul steps.
+
+Short-sequence/many-head workloads (the action decoder's clip
+transformer) favor Ulysses; very long sequences with few heads favor
+the ring. Both are exposed through the same ``attention_fn`` adapter
+so the trainer picks per config (`sp_strategy`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from evam_tpu.parallel.ring import plain_attention
+
+
+def _ulysses_kernel(
+    q: jax.Array,  # [B, T/n, H, D] local shard
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: float,
+) -> jax.Array:
+    def seq_to_heads(x):
+        # [B, T/n, H, D] → [B, T, H/n, D]: split the head axis n ways,
+        # concatenate the received pieces along sequence
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    out = plain_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    head_axis: str | None = "model",
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention over
+    ``mesh.shape[seq_axis]`` shards.
+
+    q/k/v: [B, T, H, D] global arrays. Heads additionally shard over
+    ``head_axis`` (tensor parallel — heads are independent, mirroring
+    ring_attention), so the requirement is
+    ``H % (seq_shards * head_shards) == 0`` and ``T % seq_shards == 0``.
+    """
+    n = mesh.shape[seq_axis]
+    m = mesh.shape.get(head_axis, 1) if head_axis in mesh.axis_names else 1
+    scale = q.shape[-1] ** -0.5
+    if n == 1 and m == 1:
+        return plain_attention(q, k, v, causal=causal, scale=scale)
+    h, t = q.shape[2], q.shape[1]
+    if h % (n * m):
+        raise ValueError(
+            f"ulysses needs heads % (seq*model shards) == 0, got H={h} "
+            f"seq={n} model={m} (use ring_attention to scale past the "
+            "head count)"
+        )
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by {n} shards")
+
+    spec = P(
+        batch_axis if batch_axis in mesh.axis_names else None,
+        seq_axis,
+        head_axis if head_axis in mesh.axis_names else None,
+        None,
+    )
+    kernel = functools.partial(
+        _ulysses_kernel, axis_name=seq_axis, causal=causal, scale=scale)
+    sharded = shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return sharded(q, k, v)
+
+
+def make_flax_attention_fn(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    head_axis: str | None = "model",
+    causal: bool = False,
+) -> Callable:
+    """Ulysses as a drop-in ``attention_fn`` for
+    `flax.linen.MultiHeadDotProductAttention` (same adapter contract
+    as ring.make_flax_attention_fn — param tree unchanged)."""
+
+    def attention_fn(query, key, value, **kwargs):
+        return ulysses_attention(
+            query, key, value, mesh,
+            seq_axis=seq_axis, batch_axis=batch_axis,
+            head_axis=head_axis, causal=causal,
+        )
+
+    return attention_fn

@@ -8,11 +8,15 @@ OUT=${1:-/tmp/tpu_battery}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+FAILED=0
 run() {
     name=$1; shift
     echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
     timeout 900 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
-    echo "rc=$? $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    local rc=$?
+    echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    return $rc
 }
 
 # 1. cheapest first: one clean headline number at the current default
@@ -39,4 +43,5 @@ run bench_audio python bench.py --config audio --seconds 6
 # 7. host-ingest path (true PCIe/tunnel transfer)
 run bench_host python bench.py --ingest host --batch 8 --depth 2 --seconds 6
 
-echo "battery complete -> $OUT" | tee -a "$OUT/battery.log"
+echo "battery complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
+exit $((FAILED > 0))
