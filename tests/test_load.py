@@ -69,6 +69,39 @@ class TestMultiStreamLoad:
         # frames per batch (occupancy is normalized to max_batch)
         assert stats[key]["items"] / stats[key]["batches"] > 4.0, stats[key]
 
+    def test_64_streams_north_star_shape(self, registry):
+        """The BASELINE north-star stream count (64 concurrent
+        detection streams into ONE shared engine), scaled to CI model
+        sizes: every stream completes, none starves, and cross-stream
+        batching stays effective at this fan-in."""
+        n, frames = 64, 8
+        instances = []
+        for i in range(n):
+            inst = registry.start_instance(
+                "object_detection", "person_vehicle_bike",
+                {
+                    "source": {
+                        "uri": f"synthetic://96x96@30?count={frames}"
+                               f"&seed={100 + i}",
+                        "type": "uri",
+                    },
+                    "destination": {"metadata": {"type": "null"}},
+                },
+            )
+            instances.append(inst)
+        deadline = time.time() + 300
+        for inst in instances:
+            inst.wait(timeout=max(1, deadline - time.time()))
+        states = [i.state.value for i in instances]
+        assert states.count("COMPLETED") == n, states
+        # fairness: every stream got all its frames through
+        per_stream = [i._runner.frames_out for i in instances]
+        assert min(per_stream) == frames, per_stream
+        stats = registry.hub.stats()
+        key = next(k for k in stats if k.startswith("detect:"))
+        # 64-way fan-in must pack batches well beyond trickle level
+        assert stats[key]["items"] / stats[key]["batches"] > 6.0, stats[key]
+
     def test_latency_histogram_populated(self, registry):
         # Self-sufficient: run one tiny stream, then check histograms.
         inst = registry.start_instance(
