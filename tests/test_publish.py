@@ -174,3 +174,26 @@ class TestEncode:
             encode_frame(frame, "jpeg", 200)
         with pytest.raises(ValueError):
             encode_frame(frame, "webp")
+
+
+class TestAnnotate:
+    def test_overlays_painted_and_source_untouched(self):
+        import numpy as np
+
+        from evam_tpu.publish.annotate import annotate_frame
+        from evam_tpu.stages.context import FrameContext, Region, Tensor
+
+        frame = np.zeros((80, 120, 3), np.uint8)
+        ctx = FrameContext(frame=frame, pts_ns=0, seq=0, stream_id="t")
+        r = Region(0.25, 0.25, 0.75, 0.75, 0.9, 1, "person")
+        r.object_id = 3
+        r.tensors.append(
+            Tensor(name="color", confidence=0.8, label_id=2, label="white"))
+        ctx.regions = [r]
+        out = annotate_frame(ctx)
+        assert out.shape == frame.shape
+        assert out.any(), "no overlay pixels painted"
+        assert not frame.any(), "source frame must not be mutated"
+        # box edges land where rect() says (green channel dominates)
+        x, y, bw, bh = r.rect(120, 80)
+        assert out[y, x + bw // 2, 1] > 0
