@@ -21,8 +21,19 @@ quantized checkpoint format, ~0.5–2% typical top-1 cost on convnets.
 
 from __future__ import annotations
 
+import os as _os
+
 import jax.numpy as jnp
 from jax import lax
+
+#: "xla" (default) or "pallas" — EVAM_QGEMM=pallas routes the int8
+#: GEMMs (dense + 1×1 convs) through the fused pallas kernel
+#: (ops/pallas_qgemm.py). NOT numerics-neutral: the pallas route
+#: quantizes activations per ROW/pixel (finer than this module's
+#: per-example scale), so flipping the backend changes int8 model
+#: outputs slightly (for the better) — the hardware A/B must compare
+#: both speed and the PTQ error budget before switching defaults.
+QGEMM_BACKEND = _os.environ.get("EVAM_QGEMM", "xla")
 
 
 def quantize_weight(kernel: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -59,6 +70,20 @@ def quant_conv(
     feature_group_count: int = 1,
 ) -> jnp.ndarray:
     """INT8 convolution with float in/out (NHWC / HWIO)."""
+    if (
+        QGEMM_BACKEND == "pallas"
+        and kernel.shape[0] == kernel.shape[1] == 1
+        and strides == (1, 1)
+        and feature_group_count == 1
+    ):
+        # 1×1 conv IS a GEMM over pixels — route through the fused
+        # pallas int8 kernel
+        from evam_tpu.ops.pallas_qgemm import pallas_quant_dense
+
+        b, h, w_, c = x.shape
+        out = pallas_quant_dense(
+            x.reshape(-1, c), kernel.reshape(c, -1), bias)
+        return out.reshape(b, h, w_, -1)
     wq, w_scale = quantize_weight(kernel)
     xq, x_scale = quantize_act(x)
     y = lax.conv_general_dilated(
@@ -79,6 +104,10 @@ def quant_dense(
     x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None
 ) -> jnp.ndarray:
     """INT8 matmul with float in/out (kernel [in, out])."""
+    if QGEMM_BACKEND == "pallas" and x.ndim == 2:
+        from evam_tpu.ops.pallas_qgemm import pallas_quant_dense
+
+        return pallas_quant_dense(x, kernel, bias)
     wq, w_scale = quantize_weight(kernel)
     xq, x_scale = quantize_act(x)
     y = lax.dot_general(

@@ -92,6 +92,59 @@ def test_int8_registry_serves_fused_step():
     assert np.isfinite(out).all()
 
 
+class TestPallasQGemm:
+    """The fused pallas int8 GEMM (interpret mode on CPU) must agree
+    with the XLA quantize→dot→dequant path bit-for-bit-ish."""
+
+    def test_matches_xla_quant_dense(self):
+        from evam_tpu.ops.pallas_qgemm import pallas_quant_dense
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(48, 64)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(64, 96)) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(96,)) * 0.1, jnp.float32)
+        ref = quant_dense(x, w, b)
+        got = pallas_quant_dense(x, w, b, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_ragged_shapes_pad_correctly(self):
+        from evam_tpu.ops.pallas_qgemm import pallas_quant_dense
+
+        rng = np.random.default_rng(1)
+        # m and n deliberately not multiples of the tile sizes
+        x = jnp.asarray(rng.normal(size=(130, 32)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(32, 130)) * 0.3, jnp.float32)
+        ref = quant_dense(x, w, None)
+        got = pallas_quant_dense(x, w, None, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_backend_switch_routes_1x1_conv(self, monkeypatch):
+        """The pallas route quantizes per PIXEL (finer than the XLA
+        path's per-example scale), so compare both against the float
+        conv: pallas must be valid PTQ and no worse than XLA."""
+        from evam_tpu.ops import qlinear
+
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 8, 8, 16)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(1, 1, 16, 32)) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(32,)) * 0.1, jnp.float32)
+        fp = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+        xla_q = quant_conv(x, w, b)
+        monkeypatch.setattr(qlinear, "QGEMM_BACKEND", "pallas")
+        pallas_q = qlinear.quant_conv(x, w, b)
+        assert pallas_q.shape == fp.shape
+
+        def max_rel(a):
+            return float(jnp.abs(a - fp).max() / (jnp.abs(fp).max() + 1e-9))
+
+        assert max_rel(pallas_q) < 0.02
+        assert max_rel(pallas_q) <= max_rel(xla_q) * 1.5  # no worse
+
+
 def test_int8_outputs_track_float_outputs():
     """Quantized detector scores stay close to the float ones on the
     same weights (dynamic PTQ error budget)."""
@@ -126,3 +179,12 @@ def test_int8_outputs_track_float_outputs():
     # margins; 0.85 still catches a broken quantization path (which
     # scores ~1/num_classes agreement)
     assert agree > 0.85, f"top-class agreement {agree:.3f}"
+
+
+def test_pallas_qgemm_empty_batch():
+    from evam_tpu.ops.pallas_qgemm import pallas_quant_dense
+
+    x = jnp.zeros((0, 16), jnp.float32)
+    w = jnp.ones((16, 8), jnp.float32)
+    out = pallas_quant_dense(x, w, jnp.ones((8,)), interpret=True)
+    assert out.shape == (0, 8)

@@ -36,6 +36,9 @@ run bench_bf16 python bench.py --batch 16 --depth 2 --seconds 8
 EVAM_NMS=unroll run bench_nms_unroll python bench.py --config detect --seconds 6 || true
 run bench_nms_while python bench.py --config detect --seconds 6
 
+# 5b. pallas fused int8 GEMM vs XLA int8 (1x1 convs + dense)
+EVAM_QGEMM=pallas run bench_int8_pallas python bench.py --precision int8 --batch 16 --depth 2 --seconds 6 || true
+
 # 6. secondary configs for BASELINE coverage
 run bench_action python bench.py --config action --seconds 6
 run bench_audio python bench.py --config audio --seconds 6
