@@ -70,6 +70,9 @@ class StreamInstance:
 
         self.state = InstanceState.QUEUED
         self.error: str | None = None
+        #: set by the registry on deliberate DELETE — distinguishes
+        #: operator intent from a shutdown drain's stop()
+        self.deleted = False
         self.start_time: float | None = None
         self.end_time: float | None = None
         self._source = None
@@ -190,6 +193,28 @@ class StreamInstance:
         end = self.end_time or time.time()
         dt = max(end - self.start_time, 1e-9)
         return self._runner.frames_out / dt
+
+    def stage_state(self) -> dict[str, dict]:
+        """Snapshot of every stateful stage (keyed by stage name) for
+        streams.json persistence."""
+        out: dict[str, dict] = {}
+        for stage in self.stages:
+            try:
+                snap = stage.snapshot()
+            except Exception:  # noqa: BLE001 — state capture is best-effort
+                snap = None
+            if snap is not None:
+                out[stage.name] = snap
+        return out
+
+    def restore_stage_state(self, state: dict[str, dict]) -> None:
+        for stage in self.stages:
+            if stage.name in state:
+                try:
+                    stage.restore(state[stage.name])
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("stage %s state restore failed: %s",
+                                stage.name, exc)
 
     def status(self) -> dict[str, Any]:
         """Reference status payload shape: id, state, avg_fps,

@@ -66,6 +66,20 @@ class PipelineRegistry:
             if settings.state_dir else None
         )
         self._persist_lock = threading.Lock()
+        # Crash-resume freshness: _persist fires on lifecycle EVENTS
+        # (start/stop/finish); long-quiet periods would leave stage
+        # state (tracker id high-water) stale in streams.json if the
+        # process dies non-gracefully (SIGKILL/OOM). A low-frequency
+        # re-persist bounds that staleness window.
+        self._persist_interval_s = 30.0
+        self._persist_stop = threading.Event()
+        self._persist_thread: threading.Thread | None = None
+        if self._state_file is not None:
+            self._persist_thread = threading.Thread(
+                target=self._periodic_persist,
+                name="registry-persist", daemon=True,
+            )
+            self._persist_thread.start()
 
     # ------------------------------------------------------- preload
 
@@ -142,6 +156,7 @@ class PipelineRegistry:
         publish_fn=None,
         source=None,
         sink_fn=None,
+        saved_state: dict[str, dict] | None = None,
     ) -> StreamInstance:
         """``publish_fn``/``source`` are embedder overrides (the EII
         manager publishes (meta, frame) over the msgbus and injects an
@@ -227,6 +242,10 @@ class PipelineRegistry:
                     pass
             raise
         instance.stages = stages
+        if saved_state:
+            # BEFORE start(): the first resumed frame must already see
+            # the restored cross-frame state (tracker id high-water)
+            instance.restore_stage_state(saved_state)
         with self._lock:
             self.instances[instance.id] = instance
         instance.start()
@@ -248,6 +267,7 @@ class PipelineRegistry:
     def stop_instance(self, instance_id: str) -> StreamInstance | None:
         inst = self.instances.get(instance_id)
         if inst is not None:
+            inst.deleted = True  # deliberate: survives the drain filter
             inst.stop()
             self._persist()
         return inst
@@ -260,11 +280,33 @@ class PipelineRegistry:
     def stop_all(self) -> None:
         # Shutdown drain must keep streams.json intact: these streams
         # should re-attach on the next boot (unlike per-stream DELETE).
+        with self._lock:
+            instances = list(self.instances.values())
+        # capture WHICH streams were live before stop() flips their
+        # intent flags; their final stage state is read after the
+        # drain so no ids assigned mid-drain are lost
+        active = [i for i in instances if self._is_active(i)]
         self._draining = True
-        for inst in list(self.instances.values()):
+        self._persist_stop.set()
+        for inst in instances:
             inst.stop()
-        for inst in list(self.instances.values()):
+        for inst in instances:
             inst.wait(timeout=5)
+        for inst in active:
+            if inst._thread is not None and inst._thread.is_alive():
+                # wait() timed out: this worker may still assign ids
+                # after the snapshot below — warn, the persisted state
+                # is best-effort for a wedged stream
+                log.warning(
+                    "stream %s still draining at shutdown; persisted "
+                    "state may lag", inst.id[:8],
+                )
+        # a DELETE racing shutdown must stay deleted (its persist
+        # already excluded it) — the final write filters on the
+        # deliberate-deletion flag, not just the drain's stop()
+        self._write_state([
+            self._entry(i) for i in active if not i.deleted
+        ])
         self.hub.stop()
 
     # ------------------------------------------------- restart/resume
@@ -279,25 +321,53 @@ class PipelineRegistry:
         with self._lock:
             instances = list(self.instances.values())
         active = [
-            {
-                "pipeline": i.pipeline_name,
-                "version": i.version,
-                "request": i.request,
-            }
-            for i in instances
-            if i.state in (InstanceState.QUEUED, InstanceState.RUNNING)
-            # _stop records intent immediately; the worker thread flips
-            # state to ABORTED asynchronously, so state alone would
-            # resurrect deliberately-stopped streams on restart.
-            and not i._stop.is_set()
+            self._entry(i) for i in instances if self._is_active(i)
         ]
+        self._write_state(active)
+
+    @staticmethod
+    def _entry(inst: StreamInstance) -> dict:
+        """One streams.json record (single definition — the drain and
+        event persists must stay schema-identical)."""
+        return {
+            "pipeline": inst.pipeline_name,
+            "version": inst.version,
+            "request": inst.request,
+            # cross-frame stage state (tracker id high-water mark
+            # etc.) so a resumed stream keeps its invariants
+            "state": inst.stage_state(),
+        }
+
+    @staticmethod
+    def _is_active(inst: StreamInstance) -> bool:
+        # _stop records intent immediately; the worker thread flips
+        # state to ABORTED asynchronously, so state alone would
+        # resurrect deliberately-stopped streams on restart.
+        return (
+            inst.state in (InstanceState.QUEUED, InstanceState.RUNNING)
+            and not inst._stop.is_set()
+        )
+
+    def _periodic_persist(self) -> None:
+        while not self._persist_stop.wait(self._persist_interval_s):
+            if self._draining:
+                return
+            with self._lock:
+                any_active = any(
+                    self._is_active(i) for i in self.instances.values())
+            if any_active:
+                self._persist()
+
+    def _write_state(self, entries: list[dict]) -> None:
         # Atomic replace under a lock: a finishing stream's on_finish
         # races a DELETE's persist; interleaved write_text calls would
         # corrupt the file and poison the next boot's resume().
+        if self._state_file is None:
+            return
         with self._persist_lock:
             self._state_file.parent.mkdir(parents=True, exist_ok=True)
             tmp = self._state_file.with_suffix(".tmp")
-            tmp.write_text(json.dumps(active, indent=2))
+            tmp.write_text(json.dumps(entries, indent=2))
             os.replace(tmp, self._state_file)
 
     def resume(self) -> int:
@@ -313,7 +383,10 @@ class PipelineRegistry:
         n = 0
         for e in entries:
             try:
-                self.start_instance(e["pipeline"], e["version"], e["request"])
+                self.start_instance(
+                    e["pipeline"], e["version"], e["request"],
+                    saved_state=e.get("state") or None,
+                )
                 n += 1
             except Exception as exc:  # noqa: BLE001
                 log.warning("resume of %s/%s failed: %s",

@@ -33,6 +33,19 @@ class Stage:
     def close(self) -> None:
         pass
 
+    # ---- stream-state checkpointing (SURVEY §5.4 + §7 "tracking
+    # statefulness"): stages with cross-frame state can round-trip a
+    # JSON-serializable snapshot through the stream registry's
+    # streams.json so a restarted server resumes without breaking
+    # downstream invariants (e.g. tracker id monotonicity).
+
+    def snapshot(self) -> dict | None:
+        """JSON-serializable cross-frame state, or None (stateless)."""
+        return None
+
+    def restore(self, state: dict) -> None:
+        """Re-apply a snapshot() on a freshly built stage."""
+
 
 class AsyncStage(Stage):
     """Engine-backed stage: submit() returns a Future (or None to skip

@@ -162,3 +162,13 @@ class TrackStage(Stage):
     def process(self, ctx: FrameContext) -> list[FrameContext]:
         self.tracker.update(ctx.regions)
         return [ctx]
+
+    def snapshot(self) -> dict | None:
+        # id monotonicity is the cross-restart invariant consumers
+        # depend on (object_id in published metadata, reference
+        # evas/publisher.py:210); track boxes themselves re-associate
+        # within a few frames and are not worth serializing
+        return {"next_id": self.tracker._next_id}
+
+    def restore(self, state: dict) -> None:
+        self.tracker._next_id = int(state.get("next_id", 1))

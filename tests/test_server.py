@@ -196,7 +196,8 @@ class TestPersistence:
 
     def test_completed_streams_not_resumed(self, registry):
         # A finished stream must leave the state file (no duplicate
-        # replay on restart); a drain (stop_all) must NOT rewrite it.
+        # replay on restart); a drain (stop_all) rewrites the file but
+        # only with still-active, non-deleted streams.
         body = {
             "source": {"uri": "synthetic://96x96@30?count=2", "type": "uri"},
             "destination": {"metadata": {"type": "null"}},
@@ -211,3 +212,48 @@ class TestPersistence:
         assert not any(
             e["request"]["source"]["uri"].endswith("count=2") for e in entries
         )
+
+
+class TestStageStatePersistence:
+    def test_tracker_ids_survive_restart(self, tmp_path_factory):
+        """Tracker id monotonicity across a server restart: the
+        resumed stream must not re-issue object_ids a consumer already
+        saw (SURVEY §7 'tracking statefulness' + §5.4 resume)."""
+        from evam_tpu.stages.track import TrackStage
+
+        state_dir = tmp_path_factory.mktemp("trackstate")
+        settings = Settings(
+            pipelines_dir=str(REPO / "pipelines"),
+            state_dir=str(state_dir),
+        )
+        model_registry = ModelRegistry(
+            dtype="float32", input_overrides=SMALL, width_overrides=NARROW)
+        hub = EngineHub(model_registry, plan=build_mesh(), max_batch=16,
+                        deadline_ms=4.0)
+        reg = PipelineRegistry(settings, hub=hub)
+        body = {
+            # realtime + huge count pins the stream open so it cannot
+            # COMPLETE (and self-remove from streams.json) between the
+            # id poll and stop_all
+            "source": {"uri": "synthetic://96x96@30?count=100000",
+                       "realtime": True, "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+            "parameters": {"detection-threshold": 0.0},
+        }
+        inst = reg.start_instance(
+            "object_tracking", "person_vehicle_bike", body)
+        track = next(s for s in inst.stages if isinstance(s, TrackStage))
+        deadline = time.time() + 120
+        while track.tracker._next_id <= 1 and time.time() < deadline:
+            time.sleep(0.1)
+        assert track.tracker._next_id > 1, "tracker never assigned ids"
+        reg.stop_all()  # persists current stage state, keeps the file
+        high_water = track.tracker._next_id
+
+        reg2 = PipelineRegistry(settings, hub=hub)
+        assert reg2.resume() == 1
+        inst2 = next(iter(reg2.instances.values()))
+        track2 = next(s for s in inst2.stages if isinstance(s, TrackStage))
+        # restored BEFORE the stream started: first new id >= high water
+        assert track2.tracker._next_id >= high_water
+        reg2.stop_all()
