@@ -41,6 +41,9 @@ class TPUSettings(BaseModel):
     #: precompile every batch bucket in the background when an engine
     #: is created (kills mid-traffic compile spikes; off in tests)
     warmup: bool = True
+    #: engine stall watchdog: one batch's device round-trip bound in
+    #: seconds (0 disables); raise for very large models/compiles
+    stall_timeout_s: float = 120.0
 
 
 class Settings(BaseModel):
@@ -100,6 +103,7 @@ class Settings(BaseModel):
             "EVAM_PRECISION": ("precision", str),
             "EVAM_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
             "EVAM_WARMUP": ("warmup", _parse_bool),
+            "EVAM_STALL_TIMEOUT_S": ("stall_timeout_s", float),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

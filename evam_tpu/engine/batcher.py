@@ -49,6 +49,22 @@ class _WorkItem:
     t_submit: float
 
 
+def _safe_set_result(fut: Future, value) -> None:
+    """The watchdog may have already failed this future; a late
+    success from an unwedged backend must not crash the completer."""
+    try:
+        fut.set_result(value)
+    except Exception:  # noqa: BLE001 — InvalidStateError
+        pass
+
+
+def _safe_set_exception(fut: Future, exc: Exception) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:  # noqa: BLE001 — InvalidStateError
+        pass
+
+
 @dataclasses.dataclass
 class EngineStats:
     batches: int = 0
@@ -78,6 +94,7 @@ class BatchEngine:
         deadline_ms: float = 8.0,
         max_in_flight: int = 3,
         input_names: tuple[str, ...] = ("frames",),
+        stall_timeout_s: float = 120.0,
     ):
         self.name = name
         self.plan = plan
@@ -85,6 +102,23 @@ class BatchEngine:
         self.deadline_s = deadline_ms / 1000.0
         self.input_names = input_names
         self.stats = EngineStats()
+        #: watchdog bound on one batch's device round-trip; a wedged
+        #: backend (e.g. a dead TPU tunnel) blocks the dispatcher in
+        #: C++ forever — the watchdog can't unblock it, but it CAN
+        #: fail the stranded futures and flag the engine so /healthz
+        #: degrades and callers stop queueing into a black hole
+        #: (SURVEY §5.3 failure detection; 0 disables).
+        self.stall_timeout_s = stall_timeout_s
+        #: set when a batch exceeded stall_timeout_s (engine is
+        #: considered wedged; submit() fails fast). Cleared if the
+        #: wedged call later completes (slow compile, transient hang).
+        self.stalled = threading.Event()
+        #: every dispatched-but-not-completed batch: id → (t_dispatch,
+        #: items). Covers the device launch, the _done queue wait, AND
+        #: the readback — a wedge anywhere strands nothing.
+        self._outstanding: dict[int, tuple[float, list[_WorkItem]]] = {}
+        self._next_batch_id = 0
+        self._exec_lock = threading.Lock()
 
         d = plan.data_size if plan else 1
         top = plan.pad_batch(max_batch) if plan else max_batch
@@ -124,6 +158,11 @@ class BatchEngine:
         )
         self._dispatcher.start()
         self._completer.start()
+        if self.stall_timeout_s > 0:
+            threading.Thread(
+                target=self._watchdog_loop,
+                name=f"engine-{name}-watchdog", daemon=True,
+            ).start()
 
     # ------------------------------------------------------------- API
 
@@ -131,6 +170,13 @@ class BatchEngine:
         """Enqueue one item (no batch dim); resolves to its packed row(s)."""
         if self._stop.is_set():
             raise RuntimeError(f"engine {self.name} is stopped")
+        if self.stalled.is_set():
+            # the dispatcher is wedged inside a device call — queueing
+            # more work would strand more futures
+            raise RuntimeError(
+                f"engine {self.name} is stalled (device call exceeded "
+                f"{self.stall_timeout_s:.0f}s — backend wedged?)"
+            )
         if set(inputs) != set(self.input_names):
             raise ValueError(
                 f"engine {self.name} expects inputs {self.input_names}, got {tuple(inputs)}"
@@ -252,15 +298,21 @@ class BatchEngine:
 
             self._in_flight.acquire()
             t0 = time.perf_counter()
+            with self._exec_lock:
+                bid = self._next_batch_id
+                self._next_batch_id += 1
+                self._outstanding[bid] = (t0, items)
             try:
                 out = self._run(batch)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
                 self._in_flight.release()
+                with self._exec_lock:
+                    self._outstanding.pop(bid, None)
                 for it in items:
-                    it.future.set_exception(exc)
+                    _safe_set_exception(it.future, exc)
                 log.exception("engine %s step failed", self.name)
                 continue
-            self._done.put((out, items, t0))
+            self._done.put((out, items, t0, bid))
             self.stats.batches += 1
             self.stats.items += n
             self.stats.occupancy_sum += n / b
@@ -272,19 +324,68 @@ class BatchEngine:
             entry = self._done.get()
             if entry is None:
                 break
-            out, items, t0 = entry
+            out, items, t0, bid = entry
             try:
                 host = np.asarray(out)  # single readback per batch
             except Exception as exc:  # noqa: BLE001
                 for it in items:
-                    it.future.set_exception(exc)
+                    _safe_set_exception(it.future, exc)
                 self._in_flight.release()
                 continue
+            finally:
+                with self._exec_lock:
+                    self._outstanding.pop(bid, None)
             self._in_flight.release()
+            if self.stalled.is_set():
+                # the "wedged" call was merely slow (e.g. a mid-traffic
+                # multichip compile) and has now completed — recover
+                # instead of staying bricked until restart
+                self.stalled.clear()
+                log.warning(
+                    "engine %s recovered: a previously-stalled device "
+                    "call completed; accepting work again", self.name,
+                )
             now = time.perf_counter()
             metrics.observe("evam_step_seconds", now - t0, {"engine": self.name})
             for i, it in enumerate(items):
                 metrics.observe(
                     "evam_item_latency_seconds", now - it.t_submit, {"engine": self.name}
                 )
-                it.future.set_result(host[i])
+                _safe_set_result(it.future, host[i])
+
+    def _watchdog_loop(self) -> None:
+        """Fail futures stranded behind a wedged device call and flag
+        the engine (the dispatcher/completer threads stay blocked in
+        C++ — only the service-level contract can be saved)."""
+        interval = max(self.stall_timeout_s / 4.0, 1.0)
+        while not self._stop.wait(interval):
+            now = time.perf_counter()
+            with self._exec_lock:
+                slots = list(self._outstanding.values())
+            stuck: list[_WorkItem] = []
+            for t0, items in slots:
+                if now - t0 > self.stall_timeout_s:
+                    stuck.extend(items)
+            if not stuck:
+                continue
+            self.stalled.set()
+            log.error(
+                "engine %s stalled: device call exceeded %.0fs; failing "
+                "%d stranded item(s) and rejecting new work",
+                self.name, self.stall_timeout_s, len(stuck),
+            )
+            metrics.inc("evam_engine_stalls", labels={"engine": self.name})
+            exc = TimeoutError(
+                f"engine {self.name} device call exceeded "
+                f"{self.stall_timeout_s:.0f}s (backend wedged)"
+            )
+            for it in stuck:
+                _safe_set_exception(it.future, exc)
+            # strand nothing in the queue either
+            while True:
+                try:
+                    queued = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if queued is not None:
+                    _safe_set_exception(queued.future, exc)

@@ -40,6 +40,7 @@ class EngineHub:
         deadline_ms: float = 8.0,
         wire_format: str = "i420",
         warmup: bool = False,
+        stall_timeout_s: float = 120.0,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -48,6 +49,7 @@ class EngineHub:
         self.plan = plan
         self.max_batch = max_batch
         self.deadline_ms = deadline_ms
+        self.stall_timeout_s = stall_timeout_s
         #: host→device frame encoding for video engines ("i420" halves
         #: ingest bandwidth; see evam_tpu.ops.color)
         self.wire_format = wire_format
@@ -92,6 +94,7 @@ class EngineHub:
                     max_batch=self.max_batch,
                     deadline_ms=self.deadline_ms,
                     input_names=input_names,
+                    stall_timeout_s=self.stall_timeout_s,
                 )
                 log.info("created engine %s (model %s)", key, model_key)
             return self._engines[key]
@@ -126,6 +129,7 @@ class EngineHub:
                     max_batch=self.max_batch,
                     deadline_ms=self.deadline_ms,
                     input_names=("frames",),
+                    stall_timeout_s=self.stall_timeout_s,
                 )
                 log.info("created fused engine %s", key)
             return self._engines[key]
@@ -158,6 +162,9 @@ class EngineHub:
             "engines": len(engines),
             "warmed": warmed,
             "warming": len(engines) - warmed,
+            # a wedged backend (stall watchdog fired) is a liveness
+            # failure, not a warmup phase — monitoring must see it
+            "stalled": sum(1 for e in engines if e.stalled.is_set()),
         }
 
     def stop(self) -> None:

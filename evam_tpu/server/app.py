@@ -121,10 +121,13 @@ def build_app(
 
     async def healthz(request: web.Request) -> web.Response:
         ready = registry.hub.readiness()
-        return web.json_response({
-            "status": "warming" if ready["warming"] else "ok",
-            **ready,
-        })
+        if ready.get("stalled"):
+            # 503 so HTTP-status readiness probes (helm chart httpGet)
+            # actually take the pod out of rotation
+            return web.json_response(
+                {"status": "stalled", **ready}, status=503)
+        status = "warming" if ready["warming"] else "ok"
+        return web.json_response({"status": status, **ready})
 
     app.add_routes([
         web.get("/pipelines", list_pipelines),

@@ -52,6 +52,7 @@ class PipelineRegistry:
                 max_batch=settings.tpu.max_batch,
                 deadline_ms=settings.tpu.batch_deadline_ms,
                 warmup=settings.tpu.warmup,
+                stall_timeout_s=settings.tpu.stall_timeout_s,
             )
         self.hub = hub
         self.instances: dict[str, StreamInstance] = {}
@@ -302,10 +303,13 @@ class PipelineRegistry:
                     "state may lag", inst.id[:8],
                 )
         # a DELETE racing shutdown must stay deleted (its persist
-        # already excluded it) — the final write filters on the
-        # deliberate-deletion flag, not just the drain's stop()
+        # already excluded it), and a stream that finished NATURALLY
+        # during the drain must not be replayed on the next boot —
+        # only aborted/still-running streams re-attach
         self._write_state([
-            self._entry(i) for i in active if not i.deleted
+            self._entry(i) for i in active
+            if not i.deleted
+            and i.state not in (InstanceState.COMPLETED, InstanceState.ERROR)
         ])
         self.hub.stop()
 

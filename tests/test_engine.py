@@ -167,3 +167,56 @@ def test_warm_async_precompiles_buckets(hub):
     # warmed engine serves traffic normally
     out = engine.submit(frames=frame).result(timeout=60)
     assert out.shape[-1] == 7
+
+
+class TestStallWatchdog:
+    def test_wedged_step_fails_futures_and_flags_engine(self):
+        """A device call that never returns (the axon-tunnel failure
+        mode) must not strand callers: the watchdog fails in-flight
+        and queued futures with TimeoutError, flags the engine, and
+        submit() starts rejecting."""
+        import threading as _t
+        from concurrent.futures import Future
+
+        from evam_tpu.engine.batcher import BatchEngine
+
+        release = _t.Event()
+
+        def wedged_step(params, frames):
+            release.wait(30)  # simulates a hung backend call
+            return frames
+
+        eng = BatchEngine(
+            "wedged", wedged_step, params=None, max_batch=2,
+            deadline_ms=1.0, stall_timeout_s=1.0,
+        )
+        try:
+            f1 = eng.submit(frames=np.zeros((2, 2), np.float32))
+            time.sleep(0.2)
+            f2 = eng.submit(frames=np.zeros((2, 2), np.float32))
+            with pytest.raises(TimeoutError):
+                f1.result(timeout=10)
+            with pytest.raises(TimeoutError):
+                f2.result(timeout=10)
+            assert eng.stalled.is_set()
+            with pytest.raises(RuntimeError, match="stalled"):
+                eng.submit(frames=np.zeros((2, 2), np.float32))
+        finally:
+            release.set()  # unwedge so stop() can join threads
+            eng.stop()
+
+    def test_healthy_engine_never_trips_watchdog(self):
+        from evam_tpu.engine.batcher import BatchEngine
+
+        eng = BatchEngine(
+            "healthy", lambda p, frames: frames * 2, params=None,
+            max_batch=4, deadline_ms=1.0, stall_timeout_s=2.0,
+        )
+        try:
+            futs = [eng.submit(frames=np.full((2,), float(i)))
+                    for i in range(8)]
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(f.result(timeout=30), i * 2.0)
+            assert not eng.stalled.is_set()
+        finally:
+            eng.stop()
