@@ -307,10 +307,22 @@ class EiiManager:
 
 def run_eii_service(settings: Settings) -> int:
     """Blocking entrypoint for ``evam-tpu serve --mode EII``."""
+    import signal
+
     from evam_tpu.obs.trace import init_observability
 
     init_observability(settings)
     manager = EiiManager(settings)
+
+    def _on_term(signum, frame):  # noqa: ARG001 — signal API
+        # k8s/compose stop sends SIGTERM: drain the pipeline and close
+        # the msgbus sockets instead of dying mid-publish (the
+        # reference relies on restart: unless-stopped alone,
+        # eii/docker-compose.yml:31)
+        log.info("SIGTERM: draining EII service")
+        manager._stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
     log.info("EII service running")
     manager.run_forever()
     return 0
