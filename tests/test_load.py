@@ -102,6 +102,39 @@ class TestMultiStreamLoad:
         # 64-way fan-in must pack batches well beyond trickle level
         assert stats[key]["items"] / stats[key]["batches"] > 6.0, stats[key]
 
+    def test_mixed_workload_families_share_hub(self, registry):
+        """BASELINE config 5's shape: detection, detect+classify+track
+        and raw decode streams running CONCURRENTLY against one hub —
+        families must not starve each other and every engine batches."""
+        specs = [
+            ("object_detection", "person_vehicle_bike", {}),
+            ("object_tracking", "person_vehicle_bike",
+             {"detection-threshold": 0.0}),
+            ("object_classification", "vehicle_attributes",
+             {"detection-properties": {"threshold": 0.0},
+              "object-class": ""}),
+            ("video_decode", "app_dst", {}),
+        ]
+        instances = []
+        for i, (name, version, params) in enumerate(specs * 3):  # 12 streams
+            instances.append(registry.start_instance(
+                name, version,
+                {
+                    "source": {
+                        "uri": f"synthetic://96x96@30?count=10&seed={i}",
+                        "type": "uri",
+                    },
+                    "destination": {"metadata": {"type": "null"}},
+                    "parameters": params,
+                },
+            ))
+        deadline = time.time() + 240
+        for inst in instances:
+            inst.wait(timeout=max(1, deadline - time.time()))
+        states = [i.state.value for i in instances]
+        assert states.count("COMPLETED") == len(instances), states
+        assert all(i._runner.frames_out == 10 for i in instances)
+
     def test_latency_histogram_populated(self, registry):
         # Self-sufficient: run one tiny stream, then check histograms.
         inst = registry.start_instance(
