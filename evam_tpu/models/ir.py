@@ -597,6 +597,50 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
         np_dt = _ELEMENT_DTYPES.get(dt)
         jdt = jnp.bfloat16 if dt == "bf16" else np_dt
         return lambda x: x.astype(jdt)
+    if t == "BatchNormInference":
+        eps = float(a.get("epsilon", "1e-5"))
+
+        def batchnorm(*inputs):
+            # opset5 order: (data, gamma, beta, mean, var); opset1
+            # used (gamma, beta, data, mean, var). The data tensor is
+            # the only rank>1 input — bind by rank so both layouts
+            # work instead of silently mis-binding.
+            ranks = [getattr(i, "ndim", 0) for i in inputs]
+            data_idx = max(range(len(inputs)), key=lambda i: ranks[i])
+            x = inputs[data_idx]
+            rest = [v for i, v in enumerate(inputs) if i != data_idx]
+            gamma, beta, mean, var = rest
+            # channel axis 1 (NCHW); params are [C]
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            g = jnp.asarray(gamma, x.dtype).reshape(shape)
+            b = jnp.asarray(beta, x.dtype).reshape(shape)
+            mu = jnp.asarray(mean, x.dtype).reshape(shape)
+            v = jnp.asarray(var, x.dtype).reshape(shape)
+            return (x - mu) * jax.lax.rsqrt(v + eps) * g + b
+        return batchnorm
+    if t == "MVN":
+        eps = float(a.get("eps", a.get("epsilon", "1e-9")))
+        inside = a.get("eps_mode", "inside_sqrt") == "inside_sqrt"
+        norm_var = a.get("normalize_variance", "true").lower() in ("1", "true")
+
+        def mvn(x, axes=None):
+            if axes is None:
+                # opset2 attrs: across_channels + spatial dims
+                across = a.get("across_channels", "false").lower() in (
+                    "1", "true")
+                ax = tuple(range(1 if across else 2, x.ndim))
+            else:
+                ax = tuple(int(i) for i in np.asarray(axes).reshape(-1))
+            mu = jnp.mean(x, axis=ax, keepdims=True)
+            out = x - mu
+            if norm_var:
+                var = jnp.mean(out * out, axis=ax, keepdims=True)
+                denom = (
+                    jnp.sqrt(var + eps) if inside else jnp.sqrt(var) + eps
+                )
+                out = out / denom
+            return out
+        return mvn
     if t == "FakeQuantize":
         levels = int(a.get("levels", "256"))
 

@@ -480,3 +480,43 @@ def test_fetch_models_from_ir(tmp_path):
     assert rc == 0
     assert (out / "emotion" / "2" / "FP32" / "model.xml").exists()
     assert (out / "emotion" / "2" / "FP32" / "model.bin").exists()
+
+
+def test_batchnorm_and_mvn_ops(tmp_path):
+    """BatchNormInference and MVN (common in un-folded OMZ exports)
+    against hand-computed outputs."""
+    b = IRBuilder("bnnet")
+    x = b.layer("Parameter", {"shape": "1,2,2,2", "element_type": "f32"},
+                out_shapes=((1, 2, 2, 2),), name="input")
+    gamma = b.const(np.asarray([2.0, 1.0], np.float32), "gamma")
+    beta = b.const(np.asarray([0.5, -0.5], np.float32), "beta")
+    mean = b.const(np.asarray([1.0, 2.0], np.float32), "mean")
+    var = b.const(np.asarray([4.0, 1.0], np.float32), "var")
+    bn = b.layer(
+        "BatchNormInference", {"epsilon": "0.0"},
+        inputs=[(x[0], x[1], (1, 2, 2, 2)), (*gamma, (2,)), (*beta, (2,)),
+                (*mean, (2,)), (*var, (2,))],
+        out_shapes=((1, 2, 2, 2),), name="bn",
+    )
+    mvn = b.layer(
+        "MVN", {"normalize_variance": "true", "eps": "1e-9",
+                "across_channels": "false"},
+        inputs=[(bn[0], bn[1], (1, 2, 2, 2))],
+        out_shapes=((1, 2, 2, 2),), name="mvn",
+    )
+    b.result((mvn[0], mvn[1], (1, 2, 2, 2)))
+    model = load_ir(b.write(tmp_path))
+
+    rng = np.random.default_rng(4)
+    xv = rng.normal(size=(1, 2, 2, 2)).astype(np.float32)
+    out = np.asarray(model.forward(model.params, xv)["mvn"])
+
+    g = np.asarray([2.0, 1.0]).reshape(1, 2, 1, 1)
+    bta = np.asarray([0.5, -0.5]).reshape(1, 2, 1, 1)
+    mu = np.asarray([1.0, 2.0]).reshape(1, 2, 1, 1)
+    v = np.asarray([4.0, 1.0]).reshape(1, 2, 1, 1)
+    bn_ref = (xv - mu) / np.sqrt(v) * g + bta
+    m = bn_ref.mean(axis=(2, 3), keepdims=True)
+    c = bn_ref - m
+    ref = c / np.sqrt((c * c).mean(axis=(2, 3), keepdims=True) + 1e-9)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
