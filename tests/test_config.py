@@ -16,11 +16,17 @@ def test_settings_from_env(monkeypatch):
     monkeypatch.setenv("DETECTION_DEVICE", "cpu")
     monkeypatch.setenv("ENABLE_RTSP", "true")
     monkeypatch.setenv("EVAM_MAX_BATCH", "16")
+    monkeypatch.setenv("EVAM_PRELOAD", "all")
+    monkeypatch.setenv("EVAM_STALL_TIMEOUT_S", "45.5")
+    monkeypatch.setenv("EVAM_PRECISION", "int8")
     s = Settings.from_env()
     assert s.run_mode == "EII"
     assert s.detection_device == "cpu"
     assert s.enable_rtsp is True
     assert s.tpu.max_batch == 16
+    assert s.preload == "all"
+    assert s.tpu.stall_timeout_s == 45.5
+    assert s.tpu.precision == "int8"
 
 
 def test_settings_file_then_env_override(tmp_path, monkeypatch):
