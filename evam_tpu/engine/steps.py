@@ -18,6 +18,7 @@ Design constraints (measured on the tunneled v5e, see engine tests):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 import jax
@@ -25,12 +26,13 @@ import jax.numpy as jnp
 
 from evam_tpu.models.registry import LoadedModel
 from evam_tpu.ops.boxes import decode_boxes
+from evam_tpu.ops.color import crop_rois_i420
 from evam_tpu.ops.nms import batched_nms
 from evam_tpu.ops.preprocess import (
     crop_rois,
     decode_wire,
-    preprocess_batch,
     preprocess_bgr,
+    preprocess_wire,
 )
 
 #: Packed detection row layout: [x0, y0, x1, y1, score, label, valid]
@@ -45,9 +47,14 @@ def _head_probs(model, name: str, out) -> jnp.ndarray:
     return jax.nn.softmax(x, axis=-1)
 
 
-def _detect_packed(params, bgr, model, anchors, max_detections,
+def _wire_spec(model: LoadedModel, wire_format: str):
+    """Model preprocess spec bound to the step's wire format."""
+    return dataclasses.replace(model.preprocess, wire_format=wire_format)
+
+
+def _detect_packed(params, x, model, anchors, max_detections,
                    iou_threshold, score_threshold):
-    x = preprocess_bgr(bgr, model.preprocess)
+    """Preprocessed input → (packed [B,K,7], boxes). See DETECT_FIELDS."""
     out = model.forward(params, x)
     boxes = decode_boxes(
         out["loc"].astype(jnp.float32), anchors, variances=model.variances
@@ -84,11 +91,12 @@ def build_detect_step(
 ) -> Callable:
     """Wire-encoded uint8 frames → packed detections [B,K,7] float32."""
     anchors = jnp.asarray(model.anchors)
+    spec = _wire_spec(model, wire_format)
 
     def step(params, frames):
-        bgr = decode_wire(frames, wire_format)
+        x = preprocess_wire(frames, spec)
         packed, _ = _detect_packed(
-            params, bgr, model, anchors, max_detections,
+            params, x, model, anchors, max_detections,
             iou_threshold, score_threshold,
         )
         return packed
@@ -125,14 +133,15 @@ def build_detect_classify_step(
     anchors = jnp.asarray(det_model.anchors)
     head_total = sum(n for _, n in cls_model.spec.heads)
     cls_pre = cls_model.preprocess
+    det_spec = _wire_spec(det_model, wire_format)
 
     def step(params, frames):
-        bgr = decode_wire(frames, wire_format)
+        x = preprocess_wire(frames, det_spec)
         packed, bx = _detect_packed(
-            params["det"], bgr, det_model, anchors, max_detections,
+            params["det"], x, det_model, anchors, max_detections,
             iou_threshold, score_threshold,
         )
-        b = bgr.shape[0]
+        b = frames.shape[0]
         eligible = packed[..., 6] > 0.5
         if allowed_label_ids is not None:
             labels = packed[..., 5]
@@ -148,7 +157,15 @@ def build_detect_classify_step(
         roi_idx = order[:, :roi_budget]
         roi_boxes = jnp.take_along_axis(bx, roi_idx[..., None], axis=1)
         roi_ok = jnp.take_along_axis(eligible, roi_idx, axis=1)
-        crops = crop_rois(bgr, roi_boxes, (cls_pre.height, cls_pre.width))
+        if wire_format == "i420":
+            # Crop straight from the wire planes — the full-res float
+            # BGR batch (800 MB at 1080p/32) never materializes.
+            crops = crop_rois_i420(
+                frames, roi_boxes, (cls_pre.height, cls_pre.width))
+        else:
+            crops = crop_rois(
+                decode_wire(frames, wire_format), roi_boxes,
+                (cls_pre.height, cls_pre.width))
         crops = crops.reshape((b * roi_budget,) + crops.shape[2:])
         cls_in = preprocess_bgr(crops, cls_pre)
         out = cls_model.forward(params["cls"], cls_in)
@@ -183,8 +200,13 @@ def build_classify_step(
 
     def step(params, frames, boxes):
         b, r = boxes.shape[:2]
-        bgr = decode_wire(frames, wire_format)
-        crops = crop_rois(bgr, boxes, (preproc.height, preproc.width))
+        if wire_format == "i420":
+            crops = crop_rois_i420(
+                frames, boxes, (preproc.height, preproc.width))
+        else:
+            crops = crop_rois(
+                decode_wire(frames, wire_format), boxes,
+                (preproc.height, preproc.width))
         crops = crops.reshape((b * r,) + crops.shape[2:])
         x = preprocess_bgr(crops, preproc)
         out = forward(params, x)  # dict head -> [B*R, n]
@@ -199,11 +221,11 @@ def build_action_encode_step(
     model: LoadedModel, wire_format: str = "bgr"
 ) -> Callable:
     """Wire-encoded uint8 frames → embeddings [B,D] float32."""
-    preproc = model.preprocess
+    spec = _wire_spec(model, wire_format)
     forward = model.forward
 
     def step(params, frames):
-        x = preprocess_bgr(decode_wire(frames, wire_format), preproc)
+        x = preprocess_wire(frames, spec)
         return forward(params, x).astype(jnp.float32)
 
     return step

@@ -434,6 +434,11 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
     import jax.numpy as jnp
     from jax import lax
 
+    from evam_tpu.ops.depthwise import (
+        depthwise_shift_nchw,
+        use_shift_depthwise,
+    )
+
     t = layer.type
     a = layer.attrs
 
@@ -460,6 +465,24 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
             nd = w2.ndim - 2
             strides = _pair(a, "strides", ",".join(["1"] * nd))
             dils = _pair(a, "dilations", ",".join(["1"] * nd))
+            if (
+                nd == 2
+                and w.shape[1] == 1 and w.shape[2] == 1
+                and g == x.shape[1]
+                and dils == (1, 1)
+                and use_shift_depthwise()
+            ):
+                # MobileNet depthwise: XLA's grouped-conv lowering is
+                # the round-2 TPU hot spot; shift-and-add instead
+                # (ops/depthwise.py).
+                pads = _conv_padding(
+                    a, nd, tuple(x.shape[2:]), tuple(w2.shape[2:]),
+                    dils, strides,
+                )
+                return depthwise_shift_nchw(
+                    x, w.reshape(g, *w.shape[3:]).astype(x.dtype),
+                    strides, tuple(pads),
+                )
             return lax.conv_general_dilated(
                 x, w2.astype(x.dtype),
                 window_strides=strides,

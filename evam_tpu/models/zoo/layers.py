@@ -14,7 +14,47 @@ from collections.abc import Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
+from evam_tpu.ops.depthwise import depthwise_conv_shift, use_shift_depthwise
 from evam_tpu.ops.qlinear import quant_conv
+
+
+class DepthwiseConv(nn.Module):
+    """3x3 depthwise conv via shift-and-add (see ops/depthwise.py).
+
+    Same param names/shapes as ``nn.Conv(C, (3,3), strides,
+    feature_group_count=C)`` — kernel [3,3,1,C] + bias [C] — so
+    swapping nn.Conv ↔ DepthwiseConv keeps checkpoints identical.
+    On the measured v5e, XLA's native grouped-conv lowering WINS
+    (7.4 ms vs 15-32 ms full-SSD, tools/profile_ssd_parts.py), so lax
+    is the default and this path is an A/B alternative for other
+    hardware. Switch: EVAM_DWCONV=lax (default) | shift.
+    """
+
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (kh, kw, 1, c)
+        )
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return depthwise_conv_shift(x, kernel, self.strides) + bias
+
+
+def _dwconv(strides: tuple[int, int], name: str | None = None):
+    if use_shift_depthwise():
+        return DepthwiseConv(strides=strides, name=name)
+
+    def apply(x):
+        return nn.Conv(
+            x.shape[-1], (3, 3), strides, padding="SAME",
+            feature_group_count=x.shape[-1], name=name,
+        )(x)
+
+    return apply
 
 
 class QuantConv(nn.Module):
@@ -85,18 +125,10 @@ class SeparableConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        in_ch = x.shape[-1]
         # depthwise stays float: grouped int8 conv with group size 1
         # has no MXU win (it's VPU-bound either way) and costs an
         # extra quant/dequant round-trip
-        x = nn.Conv(
-            in_ch,
-            (3, 3),
-            self.strides,
-            padding="SAME",
-            feature_group_count=in_ch,
-            name="Conv_0",
-        )(x)
+        x = _dwconv(self.strides, name="Conv_0")(x)
         x = self.act(x)
         x = _conv(self.quant, self.features, (1, 1), name="Conv_1")(x)
         return self.act(x)
@@ -112,17 +144,11 @@ class InvertedResidual(nn.Module):
     @nn.compact
     def __call__(self, x):
         in_ch = x.shape[-1]
-        h = nn.Conv(in_ch * self.expand, (1, 1))(x)
+        h = nn.Conv(in_ch * self.expand, (1, 1), name="Conv_0")(x)
         h = nn.relu6(h)
-        h = nn.Conv(
-            in_ch * self.expand,
-            (3, 3),
-            self.strides,
-            padding="SAME",
-            feature_group_count=in_ch * self.expand,
-        )(h)
+        h = _dwconv(self.strides, name="Conv_1")(h)
         h = nn.relu6(h)
-        h = nn.Conv(self.features, (1, 1))(h)
+        h = nn.Conv(self.features, (1, 1), name="Conv_2")(h)
         if self.strides == (1, 1) and in_ch == self.features:
             h = h + x
         return h

@@ -17,27 +17,18 @@ H/2 x W/2 plane. Studio-swing BT.601 inverse (cv2's convention).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 
 def i420_to_bgr(i420: jnp.ndarray) -> jnp.ndarray:
     """[B, H*3/2, W] uint8 → [B, H, W, 3] float32 BGR (0..255)."""
-    b, h32, w = i420.shape
-    h = (h32 * 2) // 3
-    y = i420[:, :h, :].astype(jnp.float32)
-    quarter = h // 4
-    u = i420[:, h : h + quarter, :].reshape(b, h // 2, w // 2).astype(jnp.float32)
-    v = i420[:, h + quarter :, :].reshape(b, h // 2, w // 2).astype(jnp.float32)
+    y, u, v = _split_planes(i420)
     # nearest-neighbor chroma upsample (2x) — fused by XLA
-    u = jnp.repeat(jnp.repeat(u, 2, axis=1), 2, axis=2) - 128.0
-    v = jnp.repeat(jnp.repeat(v, 2, axis=1), 2, axis=2) - 128.0
-    # studio-swing BT.601 inverse — matches cv2's I420 conventions
-    y = 1.164 * (y - 16.0)
-    r = y + 1.596 * v
-    g = y - 0.813 * v - 0.391 * u
-    bl = y + 2.018 * u
-    return jnp.clip(jnp.stack([bl, g, r], axis=-1), 0.0, 255.0)
+    u = jnp.repeat(jnp.repeat(u.astype(jnp.float32), 2, axis=1), 2, axis=2)
+    v = jnp.repeat(jnp.repeat(v.astype(jnp.float32), 2, axis=1), 2, axis=2)
+    return _bt601(y.astype(jnp.float32), u, v)
 
 
 def bgr_to_i420_host(frame: np.ndarray) -> np.ndarray:
@@ -57,3 +48,83 @@ def i420_shape(height: int, width: int) -> tuple[int, int]:
             f"{height}x{width}"
         )
     return (height * 3 // 2, width)
+
+
+def _split_planes(i420: jnp.ndarray):
+    """[B, H*3/2, W] uint8 → (y [B,H,W], u, v [B,H/2,W/2])."""
+    b, h32, w = i420.shape
+    h = (h32 * 2) // 3
+    quarter = h // 4
+    y = i420[:, :h, :]
+    u = i420[:, h : h + quarter, :].reshape(b, h // 2, w // 2)
+    v = i420[:, h + quarter :, :].reshape(b, h // 2, w // 2)
+    return y, u, v
+
+
+def _bt601(y, u, v):
+    """Studio-swing BT.601 inverse on float planes → BGR stack."""
+    yy = 1.164 * (y - 16.0)
+    uu = u - 128.0
+    vv = v - 128.0
+    r = yy + 1.596 * vv
+    g = yy - 0.813 * vv - 0.391 * uu
+    bl = yy + 2.018 * uu
+    return jnp.clip(jnp.stack([bl, g, r], axis=-1), 0.0, 255.0)
+
+
+def i420_resize_to_bgr(
+    i420: jnp.ndarray, out_hw: tuple[int, int]
+) -> jnp.ndarray:
+    """[B, H*3/2, W] uint8 → resized [B, th, tw, 3] float32 BGR.
+
+    Resizes each plane directly (Y at full res, U/V from half res) with
+    separable matmuls — W rides the lane dimension at full width — and
+    converts colorspace at *target* resolution. Replaces
+    decode-then-resize, which materialized the full-res float BGR batch
+    (800 MB at 1080p/32) and contracted with C=3 in the lanes: the
+    round-2 ~26 ms/batch preprocess hot spot (PROFILE.md).
+
+    Linear resize and the affine BT.601 transform commute, so up to
+    chroma-phase rounding this equals resize(i420_to_bgr(x)).
+    """
+    from evam_tpu.ops.resize import resize_planes
+
+    y, u, v = _split_planes(i420)
+    yr = resize_planes(y, out_hw)
+    ur = resize_planes(u, out_hw)
+    vr = resize_planes(v, out_hw)
+    return _bt601(yr, ur, vr)
+
+
+def crop_rois_i420(
+    i420: jnp.ndarray,
+    boxes: jnp.ndarray,
+    out_size: tuple[int, int],
+) -> jnp.ndarray:
+    """ROI crop+resize straight from the i420 wire batch.
+
+    ``i420``: [B, H*3/2, W] uint8; ``boxes``: [B, R, 4] normalized
+    corners. Returns [B, R, oh, ow, 3] float32 BGR — the same contract
+    as ops.preprocess.crop_rois on a decoded frame, minus the need to
+    materialize the full-res BGR batch in the fused detect+classify
+    program. Nearest sampling on Y; chroma taps the co-sited half-res
+    sample (identical values to nearest-gathering a 2x-repeated
+    upsample).
+    """
+    from evam_tpu.ops.preprocess import roi_grid_indices
+
+    y, u, v = _split_planes(i420)
+    b, h, w = y.shape
+
+    def crop_one(yp, up, vp, box):
+        yi, xi = roi_grid_indices(box, (h, w), out_size)
+        yc = jnp.take(jnp.take(yp, yi, axis=0), xi, axis=1).astype(jnp.float32)
+        uc = jnp.take(jnp.take(up, yi // 2, axis=0), xi // 2, axis=1).astype(jnp.float32)
+        vc = jnp.take(jnp.take(vp, yi // 2, axis=0), xi // 2, axis=1).astype(jnp.float32)
+        return _bt601(yc, uc, vc)
+
+    return jax.vmap(
+        lambda yp, up, vp, bs: jax.vmap(
+            lambda bb: crop_one(yp, up, vp, bb)
+        )(bs)
+    )(y, u, v, boxes)

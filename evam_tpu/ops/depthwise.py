@@ -1,0 +1,144 @@
+"""Shift-and-add depthwise convolution for TPU.
+
+XLA lowers grouped convolutions with ``feature_group_count == C`` (the
+MobileNet depthwise pattern the reference's OMZ topologies use —
+person-vehicle-bike-detection-crossroad-0078 is a MobileNet-SSD,
+reference models_list/models.list.yml:1-6) far off the MXU: each
+1-channel group becomes its own padded convolution, and round-2
+profiling attributed ~33 ms of the 33.9 ms fused detect step to the
+backbone forward (PROFILE.md P3), i.e. <1% MXU utilization for a
+~1 GFLOP/frame net.
+
+A 3x3 depthwise conv is just 9 shifted elementwise multiply-adds:
+
+    out[b, i, j, c] = sum_{dy,dx} x_pad[b, s*i+dy, s*j+dx, c] * k[dy, dx, c]
+
+Expressed as 9 strided slices of the padded input, each scaled by a
+per-channel weight row and accumulated, the whole op is one fused VPU
+elementwise loop — no gather, no grouped conv, and XLA fuses the
+accumulation chain with the surrounding activation. Kernel layout is
+identical to ``lax.conv_general_dilated``'s grouped-conv RHS
+``[kh, kw, 1, C]`` so module pytrees (and checkpoints) are unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _same_pads(in_size: int, k: int, stride: int) -> tuple[int, int, int]:
+    """(pad_lo, pad_hi, out_size) matching XLA SAME-padding semantics."""
+    out = -(-in_size // stride)
+    pad_total = max((out - 1) * stride + k - in_size, 0)
+    lo = pad_total // 2
+    return lo, pad_total - lo, out
+
+
+def depthwise_conv_shift(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    strides: tuple[int, int] = (1, 1),
+) -> jnp.ndarray:
+    """SAME-padded depthwise conv via shift-and-add.
+
+    ``x``: [B, H, W, C]; ``kernel``: [kh, kw, 1, C] (grouped-conv RHS
+    layout, feature_group_count == C). Returns [B, out_h, out_w, C] in
+    ``x``'s dtype. Accumulates in f32 for parity with the XLA conv.
+    """
+    b, h, w, c = x.shape
+    kh, kw, kin, kc = kernel.shape
+    if kin != 1 or kc != c:
+        raise ValueError(
+            f"kernel {kernel.shape} is not depthwise for {c} channels"
+        )
+    sh, sw = strides
+    lo_h, hi_h, _ = _same_pads(h, kh, sh)
+    lo_w, hi_w, _ = _same_pads(w, kw, sw)
+    return depthwise_shift_nhwc(
+        x, kernel.reshape(kh, kw, c), strides,
+        ((lo_h, hi_h), (lo_w, hi_w)),
+    )
+
+
+def depthwise_shift_nhwc(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    strides: tuple[int, int],
+    padding: tuple[tuple[int, int], tuple[int, int]],
+) -> jnp.ndarray:
+    """Core shift-and-add, NHWC layout, explicit padding.
+
+    ``x``: [B, H, W, C]; ``kernel``: [kh, kw, C]. f32 accumulation.
+    """
+    b, _, _, c = x.shape
+    kh, kw, _ = kernel.shape
+    sh, sw = strides
+    (lo_h, hi_h), (lo_w, hi_w) = padding
+    xp = jnp.pad(x, ((0, 0), (lo_h, hi_h), (lo_w, hi_w), (0, 0)))
+    out_h = (xp.shape[1] - kh) // sh + 1
+    out_w = (xp.shape[2] - kw) // sw + 1
+    k = kernel.astype(jnp.float32)
+
+    acc = jnp.zeros((b, out_h, out_w, c), jnp.float32)
+    for dy in range(kh):
+        for dx in range(kw):
+            tap = lax.slice(
+                xp,
+                (0, dy, dx, 0),
+                (b, dy + sh * (out_h - 1) + 1, dx + sw * (out_w - 1) + 1, c),
+                (1, sh, sw, 1),
+            )
+            acc = acc + tap.astype(jnp.float32) * k[dy, dx]
+    return acc.astype(x.dtype)
+
+
+def depthwise_shift_nchw(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    strides: tuple[int, int],
+    padding: tuple[tuple[int, int], tuple[int, int]],
+) -> jnp.ndarray:
+    """Shift-and-add depthwise conv in NCHW (the IR importer's layout).
+
+    ``x``: [B, C, H, W]; ``kernel``: [C, kh, kw] (per-channel taps —
+    the IR GroupConvolution weight [G, 1, 1, kh, kw] squeezed).
+    """
+    b, c, _, _ = x.shape
+    kc, kh, kw = kernel.shape
+    if kc != c:
+        raise ValueError(f"kernel {kernel.shape} is not depthwise for {c} channels")
+    sh, sw = strides
+    (lo_h, hi_h), (lo_w, hi_w) = padding
+    xp = jnp.pad(x, ((0, 0), (0, 0), (lo_h, hi_h), (lo_w, hi_w)))
+    out_h = (xp.shape[2] - kh) // sh + 1
+    out_w = (xp.shape[3] - kw) // sw + 1
+    k = kernel.astype(jnp.float32)
+
+    acc = jnp.zeros((b, c, out_h, out_w), jnp.float32)
+    for dy in range(kh):
+        for dx in range(kw):
+            tap = lax.slice(
+                xp,
+                (0, 0, dy, dx),
+                (b, c, dy + sh * (out_h - 1) + 1, dx + sw * (out_w - 1) + 1),
+                (1, 1, sh, sw),
+            )
+            acc = acc + tap.astype(jnp.float32) * k[:, dy, dx][:, None, None]
+    return acc.astype(x.dtype)
+
+
+def use_shift_depthwise() -> bool:
+    """A/B switch: EVAM_DWCONV=lax (default) | shift.
+
+    Measured on the real v5e (tools/profile_ssd_parts.py, batch 32 at
+    512²): XLA's grouped-conv lowering runs the full SSD in 7.4 ms
+    while the shift-and-add variant takes 15-32 ms — the strided
+    slices lose to whatever XLA does natively on this generation, so
+    the hypothesis from the first profile pass was wrong and lax stays
+    the default. The implementation is kept behind this switch for
+    A/B on other topologies/hardware.
+    """
+    return os.environ.get("EVAM_DWCONV", "lax").lower() == "shift"

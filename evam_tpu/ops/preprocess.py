@@ -49,6 +49,23 @@ def preprocess_batch(frames: jax.Array, spec: PreprocessSpec) -> jax.Array:
     """
     if frames.dtype != jnp.uint8:
         raise ValueError(f"expected uint8 frames, got {frames.dtype}")
+    return preprocess_wire(frames, spec)
+
+
+def preprocess_wire(frames: jax.Array, spec: PreprocessSpec) -> jax.Array:
+    """Wire-encoded uint8 batch → model input, on the fused fast path.
+
+    For the hot i420 + stretch combination the planes are resized
+    *before* colorspace conversion (ops.color.i420_resize_to_bgr):
+    separable plane matmuls with W in the lanes, never materializing
+    the full-res float BGR batch — the round-2 ~26 ms/batch hot spot
+    (PROFILE.md). Other combinations decode first, then resize.
+    """
+    if spec.wire_format == "i420" and spec.resize == "stretch":
+        from evam_tpu.ops.color import i420_resize_to_bgr
+
+        x = i420_resize_to_bgr(frames, (spec.height, spec.width))
+        return _finalize(x, spec)
     return preprocess_bgr(decode_wire(frames, spec.wire_format), spec)
 
 
@@ -63,15 +80,14 @@ def decode_wire(frames: jax.Array, wire_format: str) -> jax.Array:
 
 def preprocess_bgr(x: jax.Array, spec: PreprocessSpec) -> jax.Array:
     """float32 BGR [B, H, W, 3] → model input per *spec*."""
-    out_dtype = jnp.dtype(spec.dtype)
     b, h, w, c = x.shape
-    if spec.color_space.upper() == "RGB":
-        x = x[..., ::-1]  # BGR (decode convention) → RGB
 
     th, tw = spec.height, spec.width
     if spec.resize == "stretch" or (h, w) == (th, tw):
         if (h, w) != (th, tw):
-            x = jax.image.resize(x, (b, th, tw, c), method="linear")
+            from evam_tpu.ops.resize import resize_nhwc
+
+            x = resize_nhwc(x, (th, tw))
     elif spec.resize == "aspect-ratio":
         # Letterbox: scale to fit, pad with zeros (model-proc
         # resize: aspect-ratio, reference models_list/action-recognition-0001.json:10).
@@ -94,6 +110,19 @@ def preprocess_bgr(x: jax.Array, spec: PreprocessSpec) -> jax.Array:
     else:
         raise ValueError(f"unknown resize mode {spec.resize!r}")
 
+    return _finalize(x, spec)
+
+
+def _finalize(x: jax.Array, spec: PreprocessSpec) -> jax.Array:
+    """Channel flip + range/mean/std + dtype — everything after resize.
+
+    Runs at target resolution (channel permutation commutes with the
+    linear resize, so flipping after is numerically identical and
+    touches 10-20x fewer pixels at 1080p→512).
+    """
+    out_dtype = jnp.dtype(spec.dtype)
+    if spec.color_space.upper() == "RGB":
+        x = x[..., ::-1]  # BGR (decode convention) → RGB
     if not spec.raw_range:
         x = x / 255.0
     mean = jnp.asarray(spec.mean, dtype=x.dtype)
@@ -103,6 +132,24 @@ def preprocess_bgr(x: jax.Array, spec: PreprocessSpec) -> jax.Array:
     if spec.std != (1.0, 1.0, 1.0):
         x = x / std
     return x.astype(out_dtype)
+
+
+def roi_grid_indices(
+    box: jax.Array,
+    frame_hw: tuple[int, int],
+    out_size: tuple[int, int],
+) -> tuple[jax.Array, jax.Array]:
+    """Nearest-sample row/column indices of an oh x ow grid inside a
+    normalized (x0, y0, x1, y1) box — the single box→pixel contract
+    shared by crop_rois and ops.color.crop_rois_i420."""
+    h, w = frame_hw
+    oh, ow = out_size
+    x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
+    ys = y0 * (h - 1) + (y1 - y0) * (h - 1) * jnp.linspace(0.0, 1.0, oh)
+    xs = x0 * (w - 1) + (x1 - x0) * (w - 1) * jnp.linspace(0.0, 1.0, ow)
+    yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+    xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    return yi, xi
 
 
 def crop_rois(
@@ -124,17 +171,12 @@ def crop_rois(
     x = frames.astype(jnp.float32)
 
     def crop_one(img, box):
-        x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
-        # Sample an oh x ow grid inside the box (nearest). Two
-        # separable 1-D gathers (rows, then columns) instead of one
-        # oh*ow-point 2-D gather: XLA lowers contiguous row gathers to
-        # fast dynamic slices on TPU, while the 2-D point gather
-        # scatter-reads 3-element rows (measured ~45 ms/batch hot spot
-        # in round 2 profiling, see PROFILE.md).
-        ys = y0 * (h - 1) + (y1 - y0) * (h - 1) * jnp.linspace(0.0, 1.0, oh)
-        xs = x0 * (w - 1) + (x1 - x0) * (w - 1) * jnp.linspace(0.0, 1.0, ow)
-        yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
-        xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+        # Two separable 1-D gathers (rows, then columns) instead of
+        # one oh*ow-point 2-D gather: XLA lowers contiguous row
+        # gathers to fast dynamic slices on TPU, while the 2-D point
+        # gather scatter-reads 3-element rows (measured ~45 ms/batch
+        # hot spot in round 2 profiling, see PROFILE.md).
+        yi, xi = roi_grid_indices(box, (h, w), (oh, ow))
         rows = jnp.take(img, yi, axis=0)       # [oh, W, 3]
         return jnp.take(rows, xi, axis=1)      # [oh, ow, 3]
 

@@ -172,3 +172,163 @@ def test_preprocess_i420_wire():
     out = np.asarray(preprocess_batch(jnp.asarray(i420), spec))
     assert out.shape == (1, 16, 16, 3)
     assert abs(out.mean() - 128.0) < 2.0
+
+
+def test_depthwise_shift_matches_lax_grouped_conv():
+    """Shift-and-add depthwise == XLA grouped conv (both layouts).
+
+    The grouped-conv lowering was the round-2 TPU hot spot (PROFILE.md
+    P3); the replacement must be numerically identical, strides 1 and 2,
+    odd and even spatial dims.
+    """
+    from jax import lax
+
+    from evam_tpu.ops.depthwise import (
+        depthwise_conv_shift,
+        depthwise_shift_nchw,
+    )
+
+    rng = np.random.default_rng(3)
+    for h, w, c, s in [(9, 9, 5, 1), (16, 12, 8, 2), (7, 10, 3, 2)]:
+        x = jnp.asarray(rng.standard_normal((2, h, w, c)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((3, 3, 1, c)), jnp.float32)
+        ref = lax.conv_general_dilated(
+            x, k, window_strides=(s, s), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
+        got = depthwise_conv_shift(x, k, (s, s))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+        # NCHW explicit-padding variant (the IR importer's layout)
+        xc = jnp.transpose(x, (0, 3, 1, 2))
+        kc = jnp.transpose(k[:, :, 0, :], (2, 0, 1))  # [C, kh, kw]
+        got_c = depthwise_shift_nchw(xc, kc, (s, s), ((1, 1), (1, 1)))
+        ref_c = lax.conv_general_dilated(
+            xc, k[:, :, 0, :][..., None].transpose(2, 3, 0, 1),
+            window_strides=(s, s), padding=((1, 1), (1, 1)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=c,
+        )
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_backbone_pytree_unchanged_across_dwconv_switch(monkeypatch):
+    """EVAM_DWCONV=shift|lax produce identical checkpoint pytrees."""
+    import jax
+
+    from evam_tpu.models.zoo import layers as L
+
+    def tree_shapes(params):
+        return jax.tree.map(lambda a: a.shape, params)
+
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    monkeypatch.setenv("EVAM_DWCONV", "shift")
+    p_shift = L.Backbone(width=8, extra_levels=1).init(
+        jax.random.PRNGKey(0), x)
+    monkeypatch.setenv("EVAM_DWCONV", "lax")
+    p_lax = L.Backbone(width=8, extra_levels=1).init(
+        jax.random.PRNGKey(0), x)
+    assert tree_shapes(p_shift) == tree_shapes(p_lax)
+
+    # and the two paths compute the same function on the same params
+    monkeypatch.setenv("EVAM_DWCONV", "shift")
+    y_shift = L.Backbone(width=8, extra_levels=1).apply(p_lax, x)
+    monkeypatch.setenv("EVAM_DWCONV", "lax")
+    y_lax = L.Backbone(width=8, extra_levels=1).apply(p_lax, x)
+    for a, b in zip(y_shift, y_lax):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_separable_resize_matches_jax_image():
+    """resize_nhwc (plane matmuls, bf16 compute) == jax.image.resize
+    within bf16 tolerance — same antialias/half-pixel conventions by
+    construction (matrices extracted from jax.image.resize itself)."""
+    import jax
+
+    from evam_tpu.ops.resize import resize_nhwc, resize_planes
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.integers(0, 255, (2, 54, 96, 3)).astype(np.float32))
+    ref = jax.image.resize(x, (2, 32, 32, 3), method="linear")
+    got = resize_nhwc(x, (32, 32))
+    assert np.abs(np.asarray(got) - np.asarray(ref)).max() < 2.0
+
+    # plane form, upscale direction too
+    p = jnp.asarray(rng.integers(0, 255, (2, 24, 24)).astype(np.float32))
+    refp = jax.image.resize(p, (2, 40, 56), method="linear")
+    gotp = resize_planes(p, (40, 56))
+    assert np.abs(np.asarray(gotp) - np.asarray(refp)).max() < 2.0
+
+    # the numpy weight matrix IS jax.image.resize's per-axis operator
+    # (resizing an identity matrix along axis 0 yields exactly it)
+    from evam_tpu.ops.resize import resize_matrix
+
+    for n, m in [(1080, 512), (540, 512), (24, 40), (64, 64), (7, 3)]:
+        ref_m = jax.image.resize(np.eye(n, dtype=np.float32), (m, n),
+                                 method="linear")
+        np.testing.assert_allclose(resize_matrix(n, m), np.asarray(ref_m),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_i420_fused_resize_matches_decode_then_resize():
+    """i420_resize_to_bgr == resize(i420_to_bgr(x)) up to chroma-phase
+    rounding (linear resize commutes with the affine BT.601)."""
+    import jax
+
+    from evam_tpu.ops.color import bgr_to_i420_host, i420_resize_to_bgr, i420_to_bgr
+
+    # Smooth content: the two paths filter chroma differently
+    # (nearest-upsample-then-antialias vs direct half-res resample),
+    # which only diverges on per-pixel noise.
+    yy, xx = np.mgrid[0:64, 0:96].astype(np.float32)
+    bgr = np.stack(
+        [yy * 2, xx * 1.5, 255 - yy * 1.8], axis=-1
+    ).clip(0, 255).astype(np.uint8)
+    i420 = jnp.asarray(bgr_to_i420_host(bgr)[None])
+    ref = jax.image.resize(i420_to_bgr(i420), (1, 32, 32, 3), method="linear")
+    got = i420_resize_to_bgr(i420, (32, 32))
+    assert got.shape == (1, 32, 32, 3)
+    assert np.abs(np.asarray(got) - np.asarray(ref)).mean() < 3.0
+
+
+def test_crop_rois_i420_matches_decoded_crop():
+    """Plane-space ROI crop == crop_rois on the decoded frame (chroma
+    taps the identical co-sited sample, so this is near-exact)."""
+    from evam_tpu.ops.color import bgr_to_i420_host, crop_rois_i420, i420_to_bgr
+    from evam_tpu.ops.preprocess import crop_rois
+
+    rng = np.random.default_rng(9)
+    bgr = rng.integers(0, 255, (48, 64, 3), np.uint8)
+    i420 = jnp.asarray(bgr_to_i420_host(bgr)[None])
+    boxes = jnp.asarray([[[0.1, 0.2, 0.7, 0.9], [0.0, 0.0, 1.0, 1.0]]])
+    ref = crop_rois(i420_to_bgr(i420), boxes, (16, 16))
+    got = crop_rois_i420(i420, boxes, (16, 16))
+    assert got.shape == (1, 2, 16, 16, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_preprocess_wire_fused_matches_decode_path():
+    """preprocess_wire's fused i420+stretch path == decode-then-
+    preprocess within resample tolerance."""
+    from evam_tpu.ops.color import bgr_to_i420_host
+    from evam_tpu.ops.preprocess import (
+        decode_wire,
+        preprocess_bgr,
+        preprocess_wire,
+    )
+
+    yy, xx = np.mgrid[0:64, 0:96].astype(np.float32)
+    bgr = np.stack(
+        [xx * 2, yy * 3, 128 + xx], axis=-1
+    ).clip(0, 255).astype(np.uint8)
+    i420 = jnp.asarray(bgr_to_i420_host(bgr)[None])
+    spec = PreprocessSpec(height=32, width=32, color_space="RGB",
+                          dtype="float32", wire_format="i420")
+    ref = preprocess_bgr(decode_wire(i420, "i420"), spec)
+    got = preprocess_wire(i420, spec)
+    assert got.shape == ref.shape
+    assert np.abs(np.asarray(got) - np.asarray(ref)).mean() < 3.0

@@ -1,0 +1,96 @@
+"""Why does preprocess+SSD fuse to 33 ms when SSD alone is 7 ms?
+
+Variants of profile_step.py's P3 program on the real chip:
+  A. verbatim: synth 1080p i420 -> decode -> resize 512 -> SSD
+  B. same with lax.optimization_barrier between preprocess and net
+     (keeps one jit, forbids cross-phase fusion/layout coupling)
+  C. wire=bgr instead of i420
+  D. net on directly synthesized 512^2 input (no resize) [control]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_fn(fn, iters=20, warmup=3):
+    import jax
+
+    for i in range(warmup):
+        jax.block_until_ready(fn(np.int32(i)))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(np.int32(100 + i))
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from evam_tpu.models.registry import ModelRegistry
+    from evam_tpu.ops.preprocess import decode_wire, preprocess_bgr
+
+    b, h, w = 32, 1080, 1920
+    print(f"device: {jax.devices()[0].platform} batch={b}", flush=True)
+
+    registry = ModelRegistry()
+    det = registry.get("object_detection/person_vehicle_bike")
+    params = jax.device_put(det.params)
+
+    def synth(seed, shape):
+        nn = int(np.prod(shape))
+        i = jax.lax.iota(jnp.uint32, nn)
+        bits = i * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+        return (bits >> 13).astype(jnp.uint8).reshape(shape)
+
+    def run(label, fn):
+        print(f"{label}: {bench_fn(jax.jit(fn)):7.2f} ms", flush=True)
+
+    def net_sum(x):
+        out = det.forward(params, x)
+        return (out["loc"].astype(jnp.float32).sum()
+                + out["conf"].astype(jnp.float32).sum())
+
+    # A. verbatim P3
+    def pA(seed):
+        x = preprocess_bgr(
+            decode_wire(synth(seed, (b, h * 3 // 2, w)), "i420"),
+            det.preprocess)
+        return net_sum(x)
+
+    # B. optimization barrier between phases
+    def pB(seed):
+        x = preprocess_bgr(
+            decode_wire(synth(seed, (b, h * 3 // 2, w)), "i420"),
+            det.preprocess)
+        x = jax.lax.optimization_barrier(x)
+        return net_sum(x)
+
+    # C. bgr wire
+    def pC(seed):
+        x = preprocess_bgr(
+            decode_wire(synth(seed, (b, h, w, 3)), "bgr"), det.preprocess)
+        return net_sum(x)
+
+    # D. control: net on 512^2 synth
+    def pD(seed):
+        x = synth(seed, (b, 512, 512, 3)).astype(jnp.float32)
+        return net_sum(x.astype(jnp.bfloat16))
+
+    run("A i420+resize+ssd (P3 verbatim)", pA)
+    run("B  + optimization_barrier     ", pB)
+    run("C bgr wire + resize + ssd     ", pC)
+    run("D ssd on 512^2 direct [ctrl]  ", pD)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

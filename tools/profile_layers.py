@@ -1,0 +1,155 @@
+"""Per-layer timing of the SSD backbone on the real chip.
+
+The cumulative ladder (tools/profile_step.py) attributed ~33 ms of the
+fused detect step to the backbone forward. This tool breaks that down:
+each backbone stage is timed as its own program on seed-synthesized
+on-device inputs, and the depthwise implementations are A/B'd
+(EVAM_DWCONV=shift vs lax grouped conv) so the round-2 shift-and-add
+rewrite (evam_tpu/ops/depthwise.py) has a direct hardware number.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_fn(fn, iters=20, warmup=3):
+    import jax
+
+    for i in range(warmup):
+        jax.block_until_ready(fn(np.int32(i)))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(np.int32(100 + i))
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def synth_input(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    n = int(np.prod(shape))
+
+    def synth(seed):
+        i = jax.lax.iota(jnp.uint32, n)
+        bits = i * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+        return ((bits >> 13).astype(jnp.uint8).astype(jnp.float32) / 255.0
+                ).reshape(shape).astype(dtype)
+
+    return synth
+
+
+def main() -> int:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b = int(os.environ.get("EVAM_PROFILE_BATCH", "32"))
+    size = 512
+    dt = jnp.bfloat16
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} batch={b} input={size}x{size} {dt.__name__}",
+          flush=True)
+
+    # ---- individual ops: depthwise A/B at representative shapes ----
+    from evam_tpu.ops.depthwise import depthwise_conv_shift
+
+    for (hh, cc, ss) in [(256, 32, 2), (128, 64, 1), (64, 128, 2),
+                         (64, 128, 1), (32, 256, 1), (16, 512, 1)]:
+        synth = synth_input((b, hh, hh, cc), dt)
+        key = jax.random.PRNGKey(0)
+        k = jax.random.normal(key, (3, 3, 1, cc), dt)
+
+        @jax.jit
+        def p_shift(seed, k=k, synth=synth, ss=ss):
+            return depthwise_conv_shift(synth(seed), k, (ss, ss)).astype(
+                jnp.float32).sum()
+
+        @jax.jit
+        def p_lax(seed, k=k, synth=synth, cc=cc, ss=ss):
+            return lax.conv_general_dilated(
+                synth(seed), k, window_strides=(ss, ss), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=cc,
+            ).astype(jnp.float32).sum()
+
+        ms_s = bench_fn(p_shift)
+        ms_l = bench_fn(p_lax)
+        print(f"dw3x3 {hh:3d}^2 c={cc:<4d} s={ss}: shift {ms_s:7.2f} ms | "
+              f"lax {ms_l:7.2f} ms  ({ms_l / max(ms_s, 1e-6):.1f}x)",
+              flush=True)
+
+    # ---- whole backbone: shift vs lax ----
+    from evam_tpu.models.zoo import layers as L
+
+    synth = synth_input((b, size, size, 3), dt)
+    for mode in ("shift", "lax"):
+        os.environ["EVAM_DWCONV"] = mode
+        # rebuild module tree under the switch
+        import importlib
+        importlib.reload(L)
+        net = L.Backbone(width=32, extra_levels=2)
+        params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3), dt))
+        params = jax.device_put(params)
+
+        @jax.jit
+        def fwd(seed, net=net, params=params):
+            feats = net.apply(params, synth(seed))
+            return sum(f.astype(jnp.float32).sum() for f in feats)
+
+        print(f"backbone[{mode}]: {bench_fn(fwd):7.2f} ms", flush=True)
+    os.environ.pop("EVAM_DWCONV", None)
+    importlib.reload(L)
+
+    # ---- per-stage ladder of the shift backbone ----
+    net = L.Backbone(width=32, extra_levels=2)
+    params = jax.device_put(
+        net.init(jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3), dt)))
+
+    class Prefix(nn.Module):
+        n: int
+
+        @nn.compact
+        def __call__(self, x):
+            w, q = 32, False
+            blocks = [
+                L.ConvBlock(w, strides=(2, 2), quant=q),
+                L.SeparableConv(w * 2, strides=(2, 2), quant=q),
+                L.SeparableConv(w * 2, quant=q),
+                L.SeparableConv(w * 4, strides=(2, 2), quant=q),
+                L.SeparableConv(w * 4, quant=q),
+                L.SeparableConv(w * 8, strides=(2, 2), quant=q),
+                L.SeparableConv(w * 8, quant=q),
+                L.SeparableConv(w * 16, strides=(2, 2), quant=q),
+                L.SeparableConv(w * 16, quant=q),
+            ]
+            for blk in blocks[: self.n]:
+                x = blk(x)
+            return x
+
+    prev = 0.0
+    for n in range(1, 10):
+        net_n = Prefix(n)
+        p_n = jax.device_put(
+            net_n.init(jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3), dt)))
+
+        @jax.jit
+        def fwd_n(seed, net_n=net_n, p_n=p_n):
+            return net_n.apply(p_n, synth(seed)).astype(jnp.float32).sum()
+
+        ms = bench_fn(fwd_n)
+        print(f"backbone[:{n}] {ms:7.2f} ms (+{ms - prev:6.2f})", flush=True)
+        prev = ms
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Pinpoint the 33 ms SSD-forward cost: params dtype x dw-impl x parts.
+
+profile_step.py P3 charges ~33 ms/batch-32 to the registry's SSD
+forward, yet a standalone bf16-input backbone measures ~10 ms
+(profile_layers.py). Candidate explanations, each isolated here on
+the real chip:
+  * f32 params promote the bf16 input so every conv runs in f32
+    (half MXU rate, double bandwidth);
+  * the SSD heads (tiny channel counts at /8..) add the rest;
+  * shift vs lax depthwise lowering.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_fn(fn, iters=20, warmup=3):
+    import jax
+
+    for i in range(warmup):
+        jax.block_until_ready(fn(np.int32(i)))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(np.int32(100 + i))
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    b, size = 32, 512
+    print(f"device: {jax.devices()[0].platform} batch={b} {size}^2", flush=True)
+
+    n = b * size * size * 3
+
+    def synth(seed, dt):
+        i = jax.lax.iota(jnp.uint32, n)
+        bits = i * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+        return ((bits >> 13).astype(jnp.uint8).astype(jnp.float32) / 255.0
+                ).reshape(b, size, size, 3).astype(dt)
+
+    import importlib
+
+    from evam_tpu.models.zoo import layers as L
+    from evam_tpu.models.zoo import ssd as S
+
+    for dw in ("lax", "shift"):
+        os.environ["EVAM_DWCONV"] = dw
+        importlib.reload(L)
+        importlib.reload(S)
+        net = S.SSDDetector(num_classes=4, width=32, extra_levels=2)
+        p32 = net.init(jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3)))
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p32)
+        bb = L.Backbone(width=32, extra_levels=2)
+        bbp = {"params": p32["params"]["Backbone_0"]}
+        bbp16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), bbp)
+
+        for label, params, dt in [
+            ("ssd  p=f32 x=bf16", p32, jnp.bfloat16),
+            ("ssd  p=bf16 x=bf16", p16, jnp.bfloat16),
+            ("ssd  p=f32 x=f32 ", p32, jnp.float32),
+            ("bbone p=f32 x=bf16", bbp, jnp.bfloat16),
+            ("bbone p=bf16 x=bf16", bbp16, jnp.bfloat16),
+        ]:
+            mod = bb if label.startswith("bbone") else net
+            pp = jax.device_put(params)
+
+            @jax.jit
+            def fwd(seed, mod=mod, pp=pp, dt=dt):
+                out = mod.apply(pp, synth(seed, dt))
+                if isinstance(out, dict):
+                    return sum(v.astype(jnp.float32).sum() for v in out.values())
+                return sum(f.astype(jnp.float32).sum() for f in out)
+
+            print(f"[{dw:5s}] {label}: {bench_fn(fwd):7.2f} ms", flush=True)
+    os.environ.pop("EVAM_DWCONV", None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
