@@ -33,7 +33,12 @@ class TPUSettings(BaseModel):
 
     mesh_shape: list[int] = Field(default_factory=lambda: [-1])
     mesh_axes: list[str] = Field(default_factory=lambda: ["data"])
-    max_batch: int = 64
+    #: top batch bucket. 128 is the measured p99<100 ms operating
+    #: point on the v5e (PROFILE.md); throughput-bound deployments set
+    #: EVAM_MAX_BATCH=256-512 (127-142 streams/chip measured, higher
+    #: p99) — dispatch overhead amortizes with batch, so undersizing
+    #: this is the first thing to check when a chip underdelivers.
+    max_batch: int = 128
     batch_deadline_ms: float = 8.0
     precision: str = "bfloat16"
     donate_buffers: bool = True

@@ -36,7 +36,7 @@ class EngineHub:
         self,
         registry: ModelRegistry,
         plan: MeshPlan | None = None,
-        max_batch: int = 32,
+        max_batch: int = 128,  # serving default, see TPUSettings.max_batch
         deadline_ms: float = 8.0,
         wire_format: str = "i420",
         warmup: bool = False,

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from evam_tpu.models.registry import LoadedModel
-from evam_tpu.ops.boxes import decode_boxes
+from evam_tpu.ops.boxes import decode_boxes, yolo_gather
 from evam_tpu.ops.color import crop_rois_i420
 from evam_tpu.ops.nms import batched_nms
 from evam_tpu.ops.preprocess import (
@@ -56,13 +56,24 @@ def _detect_packed(params, x, model, anchors, max_detections,
                    iou_threshold, score_threshold):
     """Preprocessed input → (packed [B,K,7], boxes). See DETECT_FIELDS."""
     out = model.forward(params, x)
-    boxes = decode_boxes(
-        out["loc"].astype(jnp.float32), anchors, variances=model.variances
-    )
-    conf = out["conf"].astype(jnp.float32)
-    # IR-imported graphs usually softmax in-graph (OMZ convention,
-    # models/ir.py output_is_prob); re-softmaxing would flatten scores.
-    scores = conf if model.conf_is_prob else jax.nn.softmax(conf, axis=-1)
+    if model.detector_kind == "yolo":
+        # RegionYolo-cut IR: raw grid maps, decoded here (fused) —
+        # scores come out as probabilities with a background column.
+        maps = [out[k].astype(jnp.float32) for k in sorted(out)]
+        boxes, scores = yolo_gather(
+            maps, model.yolo_specs,
+            (model.preprocess.height, model.preprocess.width),
+            model.spec.num_classes,
+        )
+    else:
+        boxes = decode_boxes(
+            out["loc"].astype(jnp.float32), anchors,
+            variances=model.variances,
+        )
+        conf = out["conf"].astype(jnp.float32)
+        # IR-imported graphs usually softmax in-graph (OMZ convention,
+        # models/ir.py output_is_prob); re-softmaxing flattens scores.
+        scores = conf if model.conf_is_prob else jax.nn.softmax(conf, axis=-1)
     bx, sc, lb, valid = batched_nms(
         boxes,
         scores,
@@ -90,7 +101,7 @@ def build_detect_step(
     wire_format: str = "bgr",
 ) -> Callable:
     """Wire-encoded uint8 frames → packed detections [B,K,7] float32."""
-    anchors = jnp.asarray(model.anchors)
+    anchors = jnp.asarray(model.anchors) if model.anchors is not None else None
     spec = _wire_spec(model, wire_format)
 
     def step(params, frames):
@@ -130,7 +141,8 @@ def build_detect_classify_step(
     block is all-zero iff that detection was not classified
     (softmaxed blocks sum to #heads otherwise).
     """
-    anchors = jnp.asarray(det_model.anchors)
+    anchors = (jnp.asarray(det_model.anchors)
+               if det_model.anchors is not None else None)
     head_total = sum(n for _, n in cls_model.spec.heads)
     cls_pre = cls_model.preprocess
     det_spec = _wire_spec(det_model, wire_format)

@@ -74,6 +74,8 @@ class IRLayer:
     attrs: dict[str, str]
     inputs: list[IRPort]
     outputs: list[IRPort]
+    #: op-specific payload (TensorIterator: body graph + port maps)
+    extra: Any = None
 
 
 @dataclasses.dataclass
@@ -125,6 +127,11 @@ def parse_ir(xml_path: str | Path, bin_path: str | Path | None = None) -> IRGrap
         )
     blob = Path(bin_path).read_bytes() if Path(bin_path).exists() else b""
 
+    return _parse_graph_el(root, root.get("name", xml_path.stem), blob)
+
+
+def _parse_graph_el(root, name: str, blob: bytes) -> IRGraph:
+    """Parse a <layers>+<edges> scope (the net, or a TI <body>)."""
     layers: dict[int, IRLayer] = {}
     consts: dict[int, np.ndarray] = {}
     for layer_el in root.find("layers").findall("layer"):
@@ -146,6 +153,8 @@ def parse_ir(xml_path: str | Path, bin_path: str | Path | None = None) -> IRGrap
         layers[lid] = layer
         if ltype == "Const":
             consts[lid] = _read_const(layer, blob)
+        elif ltype == "TensorIterator":
+            layer.extra = _parse_tensor_iterator(layer_el, layer, blob)
 
     edges: dict[tuple[int, int], tuple[int, int]] = {}
     for e in root.find("edges").findall("edge"):
@@ -153,7 +162,51 @@ def parse_ir(xml_path: str | Path, bin_path: str | Path | None = None) -> IRGrap
             int(e.get("from-layer")),
             int(e.get("from-port")),
         )
-    return IRGraph(root.get("name", xml_path.stem), layers, edges, consts)
+    return IRGraph(name, layers, edges, consts)
+
+
+def _parse_tensor_iterator(layer_el, layer: IRLayer, blob: bytes) -> dict:
+    """Parse a TensorIterator's <body>, <port_map> and <back_edges>.
+
+    The OMZ recurrent decoders (e.g. action-recognition-0001-decoder)
+    wrap their LSTM step in a TensorIterator that slices the time axis
+    of the input, carries hidden/cell state over back-edges, and
+    concatenates (or takes the last) per-step outputs.
+    """
+    body = _parse_graph_el(layer_el.find("body"), f"{layer.name}.body", blob)
+    pm = layer_el.find("port_map")
+
+    def _maybe(el, key):
+        v = el.get(key)
+        return int(v) if v is not None else None
+
+    in_by_port = {p.id: i for i, p in enumerate(layer.inputs)}
+    out_by_port = {p.id: i for i, p in enumerate(layer.outputs)}
+    inputs = []
+    for el in pm.findall("input"):
+        inputs.append({
+            "arg": in_by_port[int(el.get("external_port_id"))],
+            "layer": int(el.get("internal_layer_id")),
+            "axis": _maybe(el, "axis"),
+            "stride": _maybe(el, "stride") or 1,
+            "start": _maybe(el, "start") or 0,
+            "end": _maybe(el, "end"),
+        })
+    outputs = []
+    for el in pm.findall("output"):
+        outputs.append({
+            "out": out_by_port[int(el.get("external_port_id"))],
+            "layer": int(el.get("internal_layer_id")),
+            "axis": _maybe(el, "axis"),
+            "stride": _maybe(el, "stride") or 1,
+        })
+    be_el = layer_el.find("back_edges")
+    back_edges = [
+        (int(e.get("from-layer")), int(e.get("to-layer")))
+        for e in (be_el if be_el is not None else [])
+    ]
+    return {"body": body, "inputs": inputs, "outputs": outputs,
+            "back_edges": back_edges}
 
 
 def _read_const(layer: IRLayer, blob: bytes) -> np.ndarray:
@@ -722,6 +775,281 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
             # feeds batch B (same rescale as the Reshape op above)
             return jax.image.resize(x, (x.shape[0],) + _out[1:], method=method)
         return interp
+    if t in ("Sqrt", "Log", "Abs", "Negative", "Floor", "Ceiling",
+             "Erf", "HSigmoid", "SoftPlus", "Gelu"):
+        return {
+            "Sqrt": jnp.sqrt, "Log": jnp.log, "Abs": jnp.abs,
+            "Negative": jnp.negative, "Floor": jnp.floor,
+            "Ceiling": jnp.ceil, "Erf": jax.scipy.special.erf,
+            "HSigmoid": jax.nn.hard_sigmoid, "SoftPlus": jax.nn.softplus,
+            "Gelu": jax.nn.gelu,
+        }[t]
+    if t in ("Greater", "GreaterEqual", "Less", "LessEqual", "Equal",
+             "NotEqual", "LogicalAnd", "LogicalOr", "LogicalXor"):
+        fn = {
+            "Greater": jnp.greater, "GreaterEqual": jnp.greater_equal,
+            "Less": jnp.less, "LessEqual": jnp.less_equal,
+            "Equal": jnp.equal, "NotEqual": jnp.not_equal,
+            "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+            "LogicalXor": jnp.logical_xor,
+        }[t]
+        return lambda x, y: fn(x, y)
+    if t == "LogicalNot":
+        return jnp.logical_not
+    if t == "Select":
+        return lambda c, a_, b_: jnp.where(c, a_, b_.astype(a_.dtype)
+                                           if hasattr(b_, "astype") else b_)
+    if t == "Tile":
+        return lambda x, reps: jnp.tile(
+            x, tuple(int(i) for i in np.asarray(reps).reshape(-1))
+        )
+    if t == "VariadicSplit":
+        def vsplit(x, axis, lengths):
+            ax = int(np.asarray(axis))
+            lens = [int(i) for i in np.asarray(lengths).reshape(-1)]
+            # -1 means "the remainder" (at most one occurrence)
+            if -1 in lens:
+                rest = x.shape[ax] - sum(v for v in lens if v >= 0)
+                lens[lens.index(-1)] = rest
+            splits = np.cumsum(lens)[:-1].tolist()
+            return tuple(jnp.split(x, splits, axis=ax))
+        return vsplit
+    if t == "NormalizeL2":
+        eps = float(a.get("eps", "1e-12"))
+        add_mode = a.get("eps_mode", "add") == "add"
+
+        def normalize(x, axes):
+            ax = tuple(int(i) for i in np.asarray(axes).reshape(-1))
+            ss = jnp.sum(x * x, axis=ax, keepdims=True)
+            denom = jnp.sqrt(ss + eps) if add_mode else jnp.sqrt(
+                jnp.maximum(ss, eps))
+            return x / denom
+        return normalize
+    if t == "LRN":
+        # OpenVINO LRN across channel axis (NCHW axis 1)
+        alpha = float(a.get("alpha", "1e-4"))
+        beta = float(a.get("beta", "0.75"))
+        bias = float(a.get("bias", "1.0"))
+        size = int(a.get("size", "5"))
+
+        def lrn(x, axes=None):
+            if axes is not None:
+                ax = [int(i) for i in np.asarray(axes).reshape(-1)]
+                if ax != [1]:
+                    raise ValueError(
+                        f"LRN over axes {ax} ({layer.name}) is not "
+                        "supported — only across-channel (axes=[1])"
+                    )
+            half = size // 2
+            sq = x * x
+            pad = [(0, 0)] * x.ndim
+            pad[1] = (half, size - 1 - half)
+            sqp = jnp.pad(sq, pad)
+            acc = sum(
+                lax.slice_in_dim(sqp, i, i + x.shape[1], axis=1)
+                for i in range(size)
+            )
+            return x / jnp.power(bias + (alpha / size) * acc, beta)
+        return lrn
+    if t == "SpaceToDepth":
+        bs = int(a.get("block_size", "2"))
+        first = a.get("mode", "blocks_first") == "blocks_first"
+
+        def s2d(x):
+            b_, c, h, w = x.shape
+            x = x.reshape(b_, c, h // bs, bs, w // bs, bs)
+            # blocks_first: output channel order [bs*bs, C]
+            perm = (0, 3, 5, 1, 2, 4) if first else (0, 1, 3, 5, 2, 4)
+            return x.transpose(perm).reshape(
+                b_, c * bs * bs, h // bs, w // bs)
+        return s2d
+    if t == "DepthToSpace":
+        bs = int(a.get("block_size", "2"))
+        first = a.get("mode", "blocks_first") == "blocks_first"
+
+        def d2s(x):
+            b_, c, h, w = x.shape
+            co = c // (bs * bs)
+            if first:
+                x = x.reshape(b_, bs, bs, co, h, w)
+                x = x.transpose(0, 3, 4, 1, 5, 2)
+            else:
+                x = x.reshape(b_, co, bs, bs, h, w)
+                x = x.transpose(0, 1, 4, 2, 5, 3)
+            return x.reshape(b_, co, h * bs, w * bs)
+        return d2s
+    if t in ("ReduceProd", "ReduceL2"):
+        keep = a.get("keep_dims", "true").lower() in ("1", "true")
+
+        def reduce2(x, axes):
+            ax = tuple(int(i) for i in np.asarray(axes).reshape(-1))
+            if t == "ReduceProd":
+                return jnp.prod(x, axis=ax, keepdims=keep)
+            return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keep))
+        return reduce2
+    if t == "LSTMCell":
+        def lstm_cell(x, h0, c0, w, r, b):
+            # opset4 LSTMCell: W [4H, D], R [4H, H], B [4H]; gate
+            # order f, i, c, o (the OpenVINO "fico" convention).
+            gates = x @ w.T.astype(x.dtype) + h0 @ r.T.astype(x.dtype)
+            gates = gates + b.astype(x.dtype)
+            f, i, c_, o = jnp.split(gates, 4, axis=-1)
+            f = jax.nn.sigmoid(f)
+            i = jax.nn.sigmoid(i)
+            g = jnp.tanh(c_)
+            o = jax.nn.sigmoid(o)
+            c1 = f * c0 + i * g
+            h1 = o * jnp.tanh(c1)
+            return h1, c1
+        return lstm_cell
+    if t == "GRUCell":
+        if a.get("linear_before_reset", "false").lower() in ("1", "true"):
+            raise ValueError(
+                f"GRUCell {layer.name}: linear_before_reset=1 (4H bias "
+                "with a separate Rb term) is not supported — extend "
+                "gru_cell if such an IR appears"
+            )
+
+        def gru_cell(x, h0, w, r, b):
+            # opset3 GRUCell, gate order z, r, h; linear_before_reset=0
+            wz, wr, wh = jnp.split(w.astype(x.dtype), 3, axis=0)
+            rz, rr, rh = jnp.split(r.astype(x.dtype), 3, axis=0)
+            bz, br_, bh = jnp.split(b.astype(x.dtype), 3, axis=-1)
+            z = jax.nn.sigmoid(x @ wz.T + h0 @ rz.T + bz)
+            rg = jax.nn.sigmoid(x @ wr.T + h0 @ rr.T + br_)
+            hh = jnp.tanh(x @ wh.T + (rg * h0) @ rh.T + bh)
+            return (1 - z) * hh + z * h0
+        return gru_cell
+    if t == "TensorIterator":
+        ti = layer.extra
+        body: IRGraph = ti["body"]
+        constant_fold(body)
+        body_params = [
+            l for l in body.layers.values() if l.type == "Parameter"
+        ]
+        body_results = {
+            l.id: body.edges[(l.id, l.inputs[0].id)]
+            for l in body.layers.values() if l.type == "Result"
+        }
+        body_plan = []
+        for bl in body.topo_order():
+            if bl.id in body.consts or bl.type in (
+                "Parameter", "Const", "Result"
+            ):
+                continue
+            body_plan.append((
+                bl, _jax_op(bl),
+                [body.edges[(bl.id, p.id)] for p in bl.inputs],
+            ))
+        param_ids = {l.id for l in body_params}
+        back_by_param = {to: frm for frm, to in ti["back_edges"]}
+
+        def run_body(bindings: dict[int, Any]) -> dict[int, Any]:
+            """bindings: Parameter layer id → value. Returns Result
+            layer id → value."""
+            values: dict[tuple[int, int], Any] = {}
+            for pl in body_params:
+                values[(pl.id, pl.outputs[0].id)] = bindings[pl.id]
+
+            def resolve(src):
+                if src in values:
+                    return values[src]
+                if src[0] in body.consts:
+                    return body.consts[src[0]]
+                raise KeyError(f"unresolved TI body edge {src}")
+
+            for bl, op, srcs in body_plan:
+                out = op(*[resolve(s) for s in srcs])
+                if isinstance(out, tuple):
+                    for port, o in zip(bl.outputs, out):
+                        values[(bl.id, port.id)] = o
+                else:
+                    values[(bl.id, bl.outputs[0].id)] = out
+            return {rid: resolve(src) for rid, src in body_results.items()}
+
+        ti_inputs = ti["inputs"]
+        ti_outputs = ti["outputs"]
+        sliced = [m for m in ti_inputs if m["axis"] is not None]
+        if not sliced:
+            raise ValueError(
+                f"TensorIterator {layer.name} has no sliced input — "
+                "trip count is undefined for this importer"
+            )
+
+        def _norm(v: int, extent: int) -> int:
+            # OpenVINO port-map convention: negative start/end count
+            # from the end with -1 = "one past the last element"
+            # (end=-1 → full forward range; start=-1, stride=-1 →
+            # reverse from the last element).
+            return v + extent + 1 if v < 0 else v
+
+        def _slice_range(m, extent: int) -> tuple[int, int]:
+            """(begin, trips) for one sliced port-map entry."""
+            stride = m["stride"]
+            begin = _norm(m["start"], extent)
+            if m["end"] is not None:
+                end = _norm(m["end"], extent)
+            else:
+                end = extent if stride > 0 else 0
+            trips = -(-abs(end - begin) // abs(stride))  # ceil
+            # negative stride starts one below the (exclusive) begin
+            return (begin if stride > 0 else begin - 1), trips
+
+        def tensor_iterator(*inputs):
+            # Static trip count (16-frame clips etc.) — the Python
+            # loop unrolls into straight-line XLA.
+            m0 = sliced[0]
+            _, trips = _slice_range(m0, inputs[m0["arg"]].shape[m0["axis"]])
+
+            state: dict[int, Any] = {}
+            for m in ti_inputs:
+                if m["axis"] is None:
+                    state[m["layer"]] = inputs[m["arg"]]
+            per_step: dict[int, list] = {
+                m["out"]: [] for m in ti_outputs if m["axis"] is not None
+            }
+            final: dict[int, Any] = {}
+            for it in range(trips):
+                bindings = dict(state)
+                for m in ti_inputs:
+                    if m["axis"] is None:
+                        continue
+                    begin, _ = _slice_range(
+                        m, inputs[m["arg"]].shape[m["axis"]])
+                    bindings[m["layer"]] = lax.index_in_dim(
+                        inputs[m["arg"]], begin + it * m["stride"],
+                        axis=m["axis"], keepdims=True,
+                    )
+                missing = [
+                    pl.id for pl in body_params if pl.id not in bindings
+                ]
+                if missing:
+                    raise ValueError(
+                        f"TensorIterator {layer.name}: body Parameters "
+                        f"{missing} have neither a port-map input nor "
+                        "a back-edge-seeded binding"
+                    )
+                results = run_body(bindings)
+                # back edges: Result value feeds the mapped Parameter
+                # next iteration
+                for to_param, from_result in back_by_param.items():
+                    state[to_param] = results[from_result]
+                for m in ti_outputs:
+                    if m["axis"] is not None:
+                        per_step[m["out"]].append(results[m["layer"]])
+                    else:
+                        final[m["out"]] = results[m["layer"]]
+            outs: list[Any] = [None] * len(layer.outputs)
+            for m in ti_outputs:
+                if m["axis"] is not None:
+                    seq = per_step[m["out"]]
+                    if m["stride"] < 0:
+                        seq = seq[::-1]
+                    outs[m["out"]] = jnp.concatenate(seq, axis=m["axis"])
+                else:
+                    outs[m["out"]] = final[m["out"]]
+            return tuple(outs) if len(outs) > 1 else outs[0]
+        return tensor_iterator
     raise ValueError(
         f"IR layer type {t!r} ({layer.name}) is not supported by the "
         "importer; supported types cover the OMZ CNN opset — extend "
@@ -772,12 +1100,17 @@ class ImportedIRModel:
     #: classifiers and SSD conf branches ship softmaxed — re-applying
     #: softmax in the engine step would flatten the distribution)
     output_is_prob: list[bool] = dataclasses.field(default_factory=list)
-    #: set when the graph was cut at DetectionOutput
+    #: set when the graph was cut at DetectionOutput or RegionYolo
     is_detector: bool = False
+    #: "ssd" (DetectionOutput cut: anchors + loc/conf) or "yolo"
+    #: (RegionYolo cut: raw grid maps + yolo_specs)
+    detector_kind: str = "ssd"
     anchors: np.ndarray | None = None     # [A, 4] cxcywh normalized
     variances: tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
     num_classes: int = 0
     detection_attrs: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: per RegionYolo output: {"anchors": [[w,h]...] in input pixels}
+    yolo_specs: list[dict] = dataclasses.field(default_factory=list)
 
     @property
     def input_hw(self) -> tuple[int, int]:
@@ -818,16 +1151,55 @@ def build_ir_model(graph: IRGraph) -> ImportedIRModel:
 
     results = [l for l in graph.layers.values() if l.type == "Result"]
     det_layers = [l for l in graph.layers.values() if l.type == "DetectionOutput"]
+    region_layers = [
+        l for l in graph.layers.values() if l.type == "RegionYolo"
+    ]
 
     anchors = None
     variances = (0.1, 0.1, 0.2, 0.2)
     num_classes = 0
     det_attrs: dict[str, str] = {}
-    is_detector = bool(det_layers)
+    yolo_specs: list[dict] = []
+    detector_kind = "ssd"
+    is_detector = bool(det_layers) or bool(region_layers)
     #: (output_name, layer_id, port_id) to evaluate
     wanted: list[tuple[str, int, int]] = []
 
-    if is_detector:
+    if region_layers and not det_layers:
+        # YOLO-family IR: cut at each RegionYolo exactly like the SSD
+        # cut at DetectionOutput — the raw grid maps become outputs
+        # and sigmoid/grid/anchor decode runs fused in the engine step
+        # (ops.boxes.yolo_decode). The reference's gvadetect handles
+        # these via its C++ yolo output converter per frame.
+        detector_kind = "yolo"
+        for i, reg in enumerate(sorted(region_layers, key=lambda l: l.id)):
+            # spec default for do_softmax is TRUE (v2 behavior) — an IR
+            # omitting the attribute must hit the v2-unsupported guard
+            if reg.attrs.get("do_softmax", "1").lower() in ("1", "true"):
+                raise ValueError(
+                    f"RegionYolo {reg.name}: do_softmax=1 (YOLOv2 "
+                    "grid-unit anchors) is not supported — the decode "
+                    "path implements the v3 pixel-anchor convention"
+                )
+            classes = int(reg.attrs.get("classes", "20"))
+            if num_classes and classes != num_classes:
+                raise ValueError("RegionYolo heads disagree on classes")
+            num_classes = classes
+            flat = _attr_floats(reg.attrs, "anchors")
+            pairs = [
+                [flat[2 * j], flat[2 * j + 1]]
+                for j in range(len(flat) // 2)
+            ]
+            mask = [
+                int(v) for v in reg.attrs.get("mask", "").split(",") if v
+            ]
+            yolo_specs.append(
+                {"anchors": [pairs[m] for m in mask] if mask else pairs}
+            )
+            src = graph.edges[(reg.id, reg.inputs[0].id)]
+            wanted.append((f"yolo_{i}", *src))
+        det_attrs = dict(region_layers[0].attrs)
+    elif is_detector:
         det = det_layers[0]
         det_attrs = dict(det.attrs)
         num_classes = int(det.attrs.get("num_classes", "0"))
@@ -950,10 +1322,12 @@ def build_ir_model(graph: IRGraph) -> ImportedIRModel:
         output_shapes=out_shapes,
         output_is_prob=out_probs,
         is_detector=is_detector,
+        detector_kind=detector_kind,
         anchors=anchors,
         variances=variances,
         num_classes=num_classes,
         detection_attrs=det_attrs,
+        yolo_specs=yolo_specs,
     )
 
 
@@ -961,10 +1335,16 @@ def load_ir(xml_path: str | Path) -> ImportedIRModel:
     """Parse + build in one call."""
     graph = parse_ir(xml_path)
     model = build_ir_model(graph)
+    if model.detector_kind == "yolo":
+        det_note = f", yolo heads={len(model.yolo_specs)}"
+    elif model.is_detector:
+        det_note = f", detector A={len(model.anchors)}"
+    else:
+        det_note = ""
     log.info(
         "imported IR %s: input %s, outputs %s%s, %d weight tensors",
         model.name, model.input_shape, model.output_names,
-        f", detector A={len(model.anchors)}" if model.is_detector else "",
+        det_note,
         len(model.params),
     )
     return model

@@ -134,6 +134,11 @@ class LoadedModel:
     #: OMZ convention) so engine steps must not re-softmax
     conf_is_prob: bool = False
     head_is_prob: dict[str, bool] = field(default_factory=dict)
+    #: "ssd" (loc/conf + anchors) or "yolo" (RegionYolo grid maps,
+    #: decoded by ops.boxes.yolo_gather inside the engine step)
+    detector_kind: str = "ssd"
+    #: per YOLO head: {"anchors": [[w,h]...] in input pixels}
+    yolo_specs: list = field(default_factory=list)
     #: set when backed by an imported OpenVINO IR graph (models/ir.py)
     ir: Any = None
 
@@ -175,6 +180,10 @@ class LoadedModel:
                 batch = (batch * w601).sum(axis=-1, keepdims=True)
             x = jnp.transpose(batch, (0, 3, 1, 2))
             out = ir.forward(params, x)
+            if ir.detector_kind == "yolo":
+                # raw NCHW grid maps, decoded in the engine step
+                # (ops.boxes.yolo_gather)
+                return out
             if ir.is_detector:
                 b = batch.shape[0]
                 return {
@@ -408,6 +417,14 @@ class ModelRegistry:
         model_labels = list(spec.labels)
         if proc and proc.labels_for(0):
             model_labels = proc.labels_for(0)
+        if (
+            ir_model.detector_kind == "yolo"
+            and model_labels
+            and model_labels[0].lower() != "background"
+        ):
+            # NMS label ids are 1-based (background column prepended in
+            # yolo_gather); YOLO label lists are 0-based class names
+            model_labels = ["background"] + list(model_labels)
         preproc = PreprocessSpec(
             height=h, width=w, color_space="BGR", dtype=self.dtype
         )
@@ -427,6 +444,8 @@ class ModelRegistry:
             variances=ir_model.variances,
             conf_is_prob=probs.get("conf", False),
             head_is_prob=probs,
+            detector_kind=ir_model.detector_kind,
+            yolo_specs=list(ir_model.yolo_specs),
             ir=ir_model,
         )
 

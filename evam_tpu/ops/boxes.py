@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -99,3 +100,80 @@ def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     inter = wh[..., 0] * wh[..., 1]
     union = area_a[:, None] + area_b[None, :] - inter
     return inter / jnp.maximum(union, 1e-9)
+
+
+def yolo_decode(
+    feature_map: jnp.ndarray,
+    anchors: jnp.ndarray,
+    num_classes: int,
+    input_hw: tuple[int, int],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Decode one YOLO (v2/v3-style) head into normalized boxes+scores.
+
+    ``feature_map``: [B, A*(5+C), H, W] raw conv output (NCHW — the IR
+    importer cuts the graph at RegionYolo the same way it cuts SSD
+    graphs at DetectionOutput, so decode runs fused in the engine step
+    instead of on the host; reference gvadetect's yolo converter does
+    this per frame in C++). ``anchors``: [A, 2] (w, h) in input
+    pixels. Returns (boxes [B, A*H*W, 4] normalized corners, scores
+    [B, A*H*W, C]) where score = sigmoid(obj) * sigmoid(class) —
+    the v3 multi-label convention.
+    """
+    b, chan, h, w = feature_map.shape
+    a = anchors.shape[0]
+    per = 5 + num_classes
+    if chan != a * per:
+        raise ValueError(
+            f"RegionYolo map has {chan} channels, expected "
+            f"{a}*(5+{num_classes})={a * per}"
+        )
+    ih, iw = input_hw
+    x = feature_map.reshape(b, a, per, h, w)
+    tx, ty = x[:, :, 0], x[:, :, 1]
+    tw, th = x[:, :, 2], x[:, :, 3]
+    obj = x[:, :, 4]
+    cls = x[:, :, 5:]  # [B, A, C, H, W]
+
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    cx = (jax.nn.sigmoid(tx) + gx) / w
+    cy = (jax.nn.sigmoid(ty) + gy) / h
+    aw = anchors[:, 0].astype(jnp.float32)[None, :, None, None]
+    ah = anchors[:, 1].astype(jnp.float32)[None, :, None, None]
+    # cap the size logit (standard yolo guard): keeps inf/NaN out of
+    # the shared NMS when the net emits garbage (warmup, random init)
+    bw = aw * jnp.exp(jnp.minimum(tw, 10.0)) / iw
+    bh = ah * jnp.exp(jnp.minimum(th, 10.0)) / ih
+
+    boxes = jnp.stack(
+        [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], axis=2
+    )  # [B, A, 4, H, W]
+    scores = jax.nn.sigmoid(obj)[:, :, None] * jax.nn.sigmoid(cls)
+
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(b, a * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+        b, a * h * w, num_classes)
+    return boxes, scores
+
+
+def yolo_gather(
+    maps: list[jnp.ndarray],
+    specs: list[dict],
+    input_hw: tuple[int, int],
+    num_classes: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Decode and concatenate multi-scale YOLO heads; prepend the
+    background column so the result feeds batched_nms's SSD-convention
+    scores [B, A_total, 1+C]."""
+    all_boxes, all_scores = [], []
+    for m, spec in zip(maps, specs):
+        bx, sc = yolo_decode(
+            m, jnp.asarray(spec["anchors"], jnp.float32),
+            num_classes, input_hw,
+        )
+        all_boxes.append(bx)
+        all_scores.append(sc)
+    boxes = jnp.concatenate(all_boxes, axis=1)
+    scores = jnp.concatenate(all_scores, axis=1)
+    bg = jnp.zeros(scores.shape[:-1] + (1,), scores.dtype)
+    return boxes, jnp.concatenate([bg, scores], axis=-1)

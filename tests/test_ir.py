@@ -520,3 +520,358 @@ def test_batchnorm_and_mvn_ops(tmp_path):
     c = bn_ref - m
     ref = c / np.sqrt((c * c).mean(axis=(2, 3), keepdims=True) + 1e-9)
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_yolo_decode_hand_computed():
+    """ops.boxes.yolo_decode against a numpy hand-computation on a
+    1-anchor 2x2 grid with one class (the v3 convention: sigmoid xy /
+    obj / cls, pixel-unit anchors, exp wh)."""
+    import jax.numpy as jnp
+
+    from evam_tpu.ops.boxes import yolo_decode
+
+    rng = np.random.default_rng(3)
+    fmap = rng.normal(size=(1, 6, 2, 2)).astype(np.float32)
+    anchors = np.asarray([[32.0, 64.0]], np.float32)
+    boxes, scores = yolo_decode(jnp.asarray(fmap), jnp.asarray(anchors),
+                                num_classes=1, input_hw=(64, 64))
+    assert boxes.shape == (1, 4, 4) and scores.shape == (1, 4, 1)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    # cell (row i=1, col j=0) flattens to index i*2+j = 2
+    tx, ty, tw, th, obj, cls = fmap[0, :, 1, 0]
+    cx = (sig(tx) + 0.0) / 2.0
+    cy = (sig(ty) + 1.0) / 2.0
+    bw = 32.0 * np.exp(tw) / 64.0
+    bh = 64.0 * np.exp(th) / 64.0
+    exp_box = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+    np.testing.assert_allclose(np.asarray(boxes)[0, 2], exp_box, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(scores)[0, 2, 0],
+                               sig(obj) * sig(cls), rtol=1e-5)
+
+
+def _build_yolo_ir(tmp_path: Path):
+    """Conv head → RegionYolo (v3 attrs, masked anchors)."""
+    rng = np.random.default_rng(11)
+    head_w = rng.normal(size=(12, 3, 1, 1)).astype(np.float32) * 0.2
+
+    b = IRBuilder("tiny_yolo")
+    x = b.layer("Parameter", {"shape": "1,3,8,8", "element_type": "f32"},
+                out_shapes=((1, 3, 8, 8),), name="input")
+    hw = b.const(head_w, "head_w")
+    head = b.layer(
+        "Convolution",
+        {"strides": "2,2", "pads_begin": "0,0", "pads_end": "0,0",
+         "dilations": "1,1"},
+        inputs=[(x[0], x[1], (1, 3, 8, 8)), (hw[0], hw[1], head_w.shape)],
+        out_shapes=((1, 12, 4, 4),), name="yolo_head",
+    )
+    region = b.layer(
+        "RegionYolo",
+        {"classes": "1", "coords": "4", "num": "6", "do_softmax": "0",
+         "mask": "3,4",
+         "anchors": "10,14,23,27,37,58,81,82,135,169,344,319"},
+        inputs=[(head[0], head[1], (1, 12, 4, 4))],
+        out_shapes=((1, 12, 4, 4),), name="region",
+    )
+    b.result((region[0], region[1], (1, 12, 4, 4)))
+    return b.write(tmp_path), head_w
+
+
+def test_yolo_ir_cut_and_detect_step(tmp_path):
+    """RegionYolo IR: graph cut at the region layer (mask selects
+    anchors 81x82 and 135x169), registry serves it as a yolo detector,
+    and the fused detect step runs end-to-end."""
+    import jax
+
+    from evam_tpu.engine import steps as step_builders
+    from evam_tpu.models.registry import ModelRegistry
+
+    target = tmp_path / "ir_yolo" / "1" / "FP32"
+    target.mkdir(parents=True)
+    xml, head_w = _build_yolo_ir(target)
+
+    model_ir = load_ir(xml)
+    assert model_ir.detector_kind == "yolo"
+    assert model_ir.num_classes == 1
+    assert model_ir.yolo_specs == [
+        {"anchors": [[81.0, 82.0], [135.0, 169.0]]}
+    ]
+    assert model_ir.output_names == ["yolo_0"]
+
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    model = reg.get("ir_yolo/1")
+    assert model.detector_kind == "yolo"
+
+    step = step_builders.build_detect_step(
+        model, max_detections=4, wire_format="bgr", score_threshold=0.0
+    )
+    frames = np.random.default_rng(0).integers(
+        0, 255, (2, 8, 8, 3), np.uint8
+    )
+    packed = np.asarray(jax.jit(step)(model.params, frames))
+    assert packed.shape == (2, 4, 7)
+    assert np.all(packed[..., 4] >= 0.0) and np.all(packed[..., 4] <= 1.0)
+    # single class: every valid label is 1 (background column prepended)
+    valid = packed[..., 6] > 0.5
+    assert np.all(packed[..., 5][valid] == 1.0)
+
+
+def _np_lstm_fico(x, h, c, w, r, bias):
+    """Hand LSTM step, OpenVINO fico gate order."""
+    gates = x @ w.T + h @ r.T + bias
+    hs = w.shape[0] // 4
+    f, i, cc, o = (gates[:, k * hs:(k + 1) * hs] for k in range(4))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    c1 = sig(f) * c + sig(i) * np.tanh(cc)
+    h1 = sig(o) * np.tanh(c1)
+    return h1, c1
+
+
+def test_lstm_cell_ir(tmp_path):
+    """LSTMCell layer vs numpy hand-computation (fico weights)."""
+    rng = np.random.default_rng(5)
+    d, hs = 3, 2
+    w = rng.normal(size=(4 * hs, d)).astype(np.float32)
+    r = rng.normal(size=(4 * hs, hs)).astype(np.float32)
+    bias = rng.normal(size=(4 * hs,)).astype(np.float32)
+
+    b = IRBuilder("lstm1")
+    x = b.layer("Parameter", {"shape": f"1,{d}", "element_type": "f32"},
+                out_shapes=((1, d),), name="input")
+    h0 = b.const(np.zeros((1, hs), np.float32), "h0")
+    c0 = b.const(np.zeros((1, hs), np.float32), "c0")
+    wc = b.const(w, "W")
+    rc = b.const(r, "R")
+    bc = b.const(bias, "B")
+    cell = b.layer(
+        "LSTMCell", {"hidden_size": str(hs)},
+        inputs=[(x[0], x[1], (1, d)), (*h0, (1, hs)), (*c0, (1, hs)),
+                (*wc, w.shape), (*rc, r.shape), (*bc, bias.shape)],
+        out_shapes=((1, hs), (1, hs)), name="cell",
+    )
+    b.result((cell[0], cell[1], (1, hs)))
+    model = load_ir(b.write(tmp_path))
+    xin = rng.normal(size=(1, d)).astype(np.float32)
+    out = model.forward(model.params, xin)
+    got = np.asarray(out["cell"])
+    exp_h, _ = _np_lstm_fico(xin, np.zeros((1, hs), np.float32),
+                             np.zeros((1, hs), np.float32), w, r, bias)
+    np.testing.assert_allclose(got, exp_h, rtol=1e-5, atol=1e-6)
+
+
+def test_tensor_iterator_lstm_sequence(tmp_path):
+    """TensorIterator slicing the time axis with an LSTMCell body and
+    h/c back-edges — the OMZ recurrent-decoder pattern — against a
+    numpy step-by-step run."""
+    rng = np.random.default_rng(9)
+    t, d, hs = 3, 2, 2
+    w = rng.normal(size=(4 * hs, d)).astype(np.float32)
+    r = rng.normal(size=(4 * hs, hs)).astype(np.float32)
+    bias = rng.normal(size=(4 * hs,)).astype(np.float32)
+
+    # --- body (own builder: ids are body-scoped) ---
+    body = IRBuilder("body")
+    bx = body.layer("Parameter", {"shape": f"1,1,{d}", "element_type": "f32"},
+                    out_shapes=((1, 1, d),), name="xt")
+    bh = body.layer("Parameter", {"shape": f"1,{hs}", "element_type": "f32"},
+                    out_shapes=((1, hs),), name="h_in")
+    bc_ = body.layer("Parameter", {"shape": f"1,{hs}", "element_type": "f32"},
+                     out_shapes=((1, hs),), name="c_in")
+    axes = body.const(np.asarray([1], np.int64), "sq_axes")
+    sq = body.layer("Squeeze",
+                    inputs=[(bx[0], bx[1], (1, 1, d)), (*axes, (1,))],
+                    out_shapes=((1, d),), name="squeeze")
+    wc = body.const(w, "W")
+    rc = body.const(r, "R")
+    bbc = body.const(bias, "B")
+    cell = body.layer(
+        "LSTMCell", {"hidden_size": str(hs)},
+        inputs=[(sq[0], sq[1], (1, d)), (bh[0], bh[1], (1, hs)),
+                (bc_[0], bc_[1], (1, hs)), (*wc, w.shape), (*rc, r.shape),
+                (*bbc, bias.shape)],
+        out_shapes=((1, hs), (1, hs)), name="cell",
+    )
+    # Concatenated TI outputs must carry the iteration axis (size
+    # part_size) in the body result — unsqueeze h to [1,1,hs].
+    un_ax = body.const(np.asarray([1], np.int64), "un_axes")
+    h3 = body.layer("Unsqueeze",
+                    inputs=[(cell[0], cell[1], (1, hs)), (*un_ax, (1,))],
+                    out_shapes=((1, 1, hs),), name="h3")
+    r_hseq = body.result((h3[0], h3[1], (1, 1, hs)))
+    r_h = body.result((cell[0], cell[1], (1, hs)))
+    r_c = body.result((cell[0], cell[1] + 1, (1, hs)))
+    body_xml = (f'<layers>{"".join(body.layers)}</layers>'
+                f'<edges>{"".join(body.edges)}</edges>')
+
+    # --- outer net ---
+    b = IRBuilder("lstm_seq")
+    b.blob = body.blob  # body consts share the .bin
+    b._next_id = 100
+    x = b.layer("Parameter", {"shape": f"1,{t},{d}", "element_type": "f32"},
+                out_shapes=((1, t, d),), name="input")
+    h0 = b.const(np.zeros((1, hs), np.float32), "h0")
+    c0 = b.const(np.zeros((1, hs), np.float32), "c0")
+    ti_id = b._next_id
+    b._next_id += 1
+    b.layers.append(
+        f'<layer id="{ti_id}" name="ti" type="TensorIterator" version="opset1">'
+        '<input>'
+        f'<port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        f'<port id="1"><dim>1</dim><dim>{hs}</dim></port>'
+        f'<port id="2"><dim>1</dim><dim>{hs}</dim></port>'
+        '</input><output>'
+        f'<port id="3"><dim>1</dim><dim>{t}</dim><dim>{hs}</dim></port>'
+        f'<port id="4"><dim>1</dim><dim>{hs}</dim></port>'
+        '</output>'
+        '<port_map>'
+        f'<input external_port_id="0" internal_layer_id="{bx[0]}" '
+        'axis="1" stride="1" start="0"/>'
+        f'<input external_port_id="1" internal_layer_id="{bh[0]}"/>'
+        f'<input external_port_id="2" internal_layer_id="{bc_[0]}"/>'
+        f'<output external_port_id="3" internal_layer_id="{r_hseq[0]}" axis="1"/>'
+        f'<output external_port_id="4" internal_layer_id="{r_h[0]}"/>'
+        '</port_map>'
+        '<back_edges>'
+        f'<edge from-layer="{r_h[0]}" to-layer="{bh[0]}"/>'
+        f'<edge from-layer="{r_c[0]}" to-layer="{bc_[0]}"/>'
+        '</back_edges>'
+        f'<body>{body_xml}</body>'
+        '</layer>'
+    )
+    for to_port, (src_lid, src_port) in enumerate(
+        [(x[0], x[1]), h0[:2], c0[:2]]
+    ):
+        b.edges.append(
+            f'<edge from-layer="{src_lid}" from-port="{src_port}" '
+            f'to-layer="{ti_id}" to-port="{to_port}"/>'
+        )
+    # Result consumes the concatenated h sequence (TI port 3)
+    b.layers.append(
+        '<layer id="200" name="res" type="Result" version="opset1">'
+        f'<input><port id="0"><dim>1</dim><dim>{t}</dim><dim>{hs}</dim>'
+        '</port></input></layer>'
+    )
+    b.edges.append(
+        f'<edge from-layer="{ti_id}" from-port="3" '
+        'to-layer="200" to-port="0"/>'
+    )
+    model = load_ir(b.write(tmp_path))
+
+    xin = rng.normal(size=(1, t, d)).astype(np.float32)
+    got = np.asarray(model.forward(model.params, xin)["ti"])
+    h = np.zeros((1, hs), np.float32)
+    c = np.zeros((1, hs), np.float32)
+    exp = []
+    for k in range(t):
+        h, c = _np_lstm_fico(xin[:, k], h, c, w, r, bias)
+        exp.append(h)
+    np.testing.assert_allclose(got, np.stack(exp, axis=1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_misc_ops_ir(tmp_path):
+    """NormalizeL2 → Select(Greater) → Tile chain vs numpy."""
+    b = IRBuilder("miscnet")
+    x = b.layer("Parameter", {"shape": "1,4", "element_type": "f32"},
+                out_shapes=((1, 4),), name="input")
+    axes = b.const(np.asarray([1], np.int64), "axes")
+    nrm = b.layer("NormalizeL2", {"eps": "1e-9", "eps_mode": "add"},
+                  inputs=[(x[0], x[1], (1, 4)), (*axes, (1,))],
+                  out_shapes=((1, 4),), name="norm")
+    zero = b.const(np.zeros((1, 4), np.float32), "zeros")
+    gt = b.layer("Greater",
+                 inputs=[(nrm[0], nrm[1], (1, 4)), (*zero, (1, 4))],
+                 out_shapes=((1, 4),), name="gt")
+    sel = b.layer("Select",
+                  inputs=[(gt[0], gt[1], (1, 4)), (nrm[0], nrm[1], (1, 4)),
+                          (*zero, (1, 4))],
+                  out_shapes=((1, 4),), name="sel")
+    reps = b.const(np.asarray([2, 1], np.int64), "reps")
+    tile = b.layer("Tile",
+                   inputs=[(sel[0], sel[1], (1, 4)), (*reps, (2,))],
+                   out_shapes=((2, 4),), name="tile")
+    b.result((tile[0], tile[1], (2, 4)))
+    model = load_ir(b.write(tmp_path))
+    xin = np.asarray([[3.0, -4.0, 0.0, 12.0]], np.float32)
+    out = np.asarray(model.forward(model.params, xin)["tile"])
+    nrm_np = xin / np.sqrt((xin * xin).sum() + 1e-9)
+    exp = np.tile(np.where(nrm_np > 0, nrm_np, 0.0), (2, 1))
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+
+def test_space_depth_roundtrip_ir(tmp_path):
+    """SpaceToDepth then DepthToSpace (blocks_first) is identity."""
+    b = IRBuilder("s2dnet")
+    x = b.layer("Parameter", {"shape": "1,2,4,4", "element_type": "f32"},
+                out_shapes=((1, 2, 4, 4),), name="input")
+    s2d = b.layer("SpaceToDepth", {"block_size": "2", "mode": "blocks_first"},
+                  inputs=[(x[0], x[1], (1, 2, 4, 4))],
+                  out_shapes=((1, 8, 2, 2),), name="s2d")
+    d2s = b.layer("DepthToSpace", {"block_size": "2", "mode": "blocks_first"},
+                  inputs=[(s2d[0], s2d[1], (1, 8, 2, 2))],
+                  out_shapes=((1, 2, 4, 4),), name="d2s")
+    b.result((d2s[0], d2s[1], (1, 2, 4, 4)))
+    model = load_ir(b.write(tmp_path))
+    xin = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    out = np.asarray(model.forward(model.params, xin)["d2s"])
+    np.testing.assert_allclose(out, xin)
+
+
+def test_tensor_iterator_reverse_slice(tmp_path):
+    """Negative-stride port map (start=-1, stride=-1 — the OpenVINO
+    reverse-sequence convention) consumes the axis back-to-front:
+    identity body ⇒ the concatenated output is the reversed input."""
+    t, d = 4, 3
+    body = IRBuilder("rbody")
+    bx = body.layer("Parameter", {"shape": f"1,1,{d}", "element_type": "f32"},
+                    out_shapes=((1, 1, d),), name="xt")
+    r_x = body.result((bx[0], bx[1], (1, 1, d)))
+    body_xml = (f'<layers>{"".join(body.layers)}</layers>'
+                f'<edges>{"".join(body.edges)}</edges>')
+
+    b = IRBuilder("rev_ti")
+    b._next_id = 100
+    x = b.layer("Parameter", {"shape": f"1,{t},{d}", "element_type": "f32"},
+                out_shapes=((1, t, d),), name="input")
+    ti_id = b._next_id
+    b._next_id += 1
+    b.layers.append(
+        f'<layer id="{ti_id}" name="ti" type="TensorIterator" version="opset1">'
+        '<input>'
+        f'<port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        '</input><output>'
+        f'<port id="1"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        '</output>'
+        '<port_map>'
+        f'<input external_port_id="0" internal_layer_id="{bx[0]}" '
+        'axis="1" start="-1" end="0" stride="-1"/>'
+        f'<output external_port_id="1" internal_layer_id="{r_x[0]}" axis="1"/>'
+        '</port_map>'
+        f'<body>{body_xml}</body>'
+        '</layer>'
+    )
+    b.edges.append(
+        f'<edge from-layer="{x[0]}" from-port="{x[1]}" '
+        f'to-layer="{ti_id}" to-port="0"/>'
+    )
+    b.layers.append(
+        '<layer id="200" name="res" type="Result" version="opset1">'
+        f'<input><port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim>'
+        '</port></input></layer>'
+    )
+    b.edges.append(
+        f'<edge from-layer="{ti_id}" from-port="1" '
+        'to-layer="200" to-port="0"/>'
+    )
+    model = load_ir(b.write(tmp_path))
+    xin = np.arange(t * d, dtype=np.float32).reshape(1, t, d)
+    got = np.asarray(model.forward(model.params, xin)["ti"])
+    # per-iteration order is [t-1 .. 0]; concat respects iteration
+    # order for a forward (stride=+1) output map
+    np.testing.assert_allclose(got, xin[:, ::-1])

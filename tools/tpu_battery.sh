@@ -20,24 +20,31 @@ run() {
 }
 
 # 1. cheapest first: one clean headline number at the current default
+#    (b256 x d3 — see PROFILE.md operating-point table)
 run bench_default python bench.py --seconds 8
 
-# 2. cumulative phase ladder (where did the fused-step time go)
+# 2. part-wise profiles (profile_step's .sum() ladder lies for linear
+#    phases — XLA collapses them; keep all three views)
 run profile python tools/profile_step.py
+run profile_parts python tools/profile_ssd_parts.py
+run profile_fusion python tools/profile_fusion.py
 
 # 3. operating-point sweep under the latency target
-run bench_sweep python bench.py --sweep --seconds 25 --p99-target-ms 100
+run bench_sweep python bench.py --sweep --seconds 30 --p99-target-ms 100
 
-# 4. int8 vs bf16 A/B at the sweep's shape (fixed 16x2 if unknown)
-run bench_int8 python bench.py --precision int8 --batch 16 --depth 2 --seconds 8
-run bench_bf16 python bench.py --batch 16 --depth 2 --seconds 8
+# 4. int8 vs bf16 A/B at the compute-bound shape
+run bench_int8 python bench.py --precision int8 --batch 512 --depth 2 --seconds 8
+run bench_bf16 python bench.py --batch 512 --depth 2 --seconds 8
 
 # 5. NMS settle A/B
 EVAM_NMS=unroll run bench_nms_unroll python bench.py --config detect --seconds 6 || true
 run bench_nms_while python bench.py --config detect --seconds 6
 
 # 5b. pallas fused int8 GEMM vs XLA int8 (1x1 convs + dense)
-EVAM_QGEMM=pallas run bench_int8_pallas python bench.py --precision int8 --batch 16 --depth 2 --seconds 6 || true
+EVAM_QGEMM=pallas run bench_int8_pallas python bench.py --precision int8 --batch 512 --depth 2 --seconds 6 || true
+
+# 5c. depthwise lowering A/B (lax default won round 2; re-check on new hw)
+EVAM_DWCONV=shift run bench_dw_shift python bench.py --config detect --seconds 6 || true
 
 # 6. secondary configs for BASELINE coverage
 run bench_action python bench.py --config action --seconds 6
