@@ -59,7 +59,15 @@ def _detect_packed(params, x, model, anchors, max_detections,
     if model.detector_kind == "yolo":
         # RegionYolo-cut IR: raw grid maps, decoded here (fused) —
         # scores come out as probabilities with a background column.
-        maps = [out[k].astype(jnp.float32) for k in sorted(out)]
+        # Numeric sort: lexicographic would pair yolo_10 with head 2's
+        # anchors on 11+-head models.
+        keys = sorted(out, key=lambda k: int(k.rsplit("_", 1)[1]))
+        if len(keys) != len(model.yolo_specs):
+            raise ValueError(
+                f"{len(keys)} yolo outputs vs {len(model.yolo_specs)} "
+                "anchor specs — importer/model mismatch"
+            )
+        maps = [out[k].astype(jnp.float32) for k in keys]
         boxes, scores = yolo_gather(
             maps, model.yolo_specs,
             (model.preprocess.height, model.preprocess.width),

@@ -443,6 +443,12 @@ def constant_fold(graph: IRGraph) -> None:
                 log.debug("constfold %s (%s) failed: %s", layer.name, layer.type, exc)
                 out = None
             if out is not None:
+                # conform to the declared port shape (e.g. PriorBox
+                # helpers return [2, N] where the IR declares
+                # [1, 2, N]) so downstream folds see the right rank
+                want = layer.outputs[0].shape if layer.outputs else ()
+                if want and int(np.prod(out.shape)) == int(np.prod(want)):
+                    out = out.reshape(want)
                 graph.consts[layer.id] = out
                 changed = True
 

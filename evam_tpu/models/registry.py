@@ -420,10 +420,13 @@ class ModelRegistry:
         if (
             ir_model.detector_kind == "yolo"
             and model_labels
-            and model_labels[0].lower() != "background"
+            and model_labels[0].lower().strip("_")
+            not in ("background", "none")
         ):
             # NMS label ids are 1-based (background column prepended in
-            # yolo_gather); YOLO label lists are 0-based class names
+            # yolo_gather); YOLO label lists are 0-based class names.
+            # Recognize existing background rows in their common
+            # spellings ("background", "__background__", "none").
             model_labels = ["background"] + list(model_labels)
         preproc = PreprocessSpec(
             height=h, width=w, color_space="BGR", dtype=self.dtype

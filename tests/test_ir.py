@@ -875,3 +875,61 @@ def test_tensor_iterator_reverse_slice(tmp_path):
     # per-iteration order is [t-1 .. 0]; concat respects iteration
     # order for a forward (stride=+1) output map
     np.testing.assert_allclose(got, xin[:, ::-1])
+
+
+def test_omz_shaped_ssd_vs_torch(tmp_path):
+    """Full crossroad-0078-shaped topology (MobileNet-v1 depthwise
+    ladder, 2-scale SSD heads, Transpose/Reshape/Concat wiring,
+    in-graph conf SoftMax, PriorBoxClustered branches, DetectionOutput
+    cut): imported forward vs an INDEPENDENT torch implementation
+    built from the same weights."""
+    import sys as _sys
+    from pathlib import Path as _P
+    _sys.path.insert(0, str(_P(__file__).resolve().parent.parent / "tools"))
+    from gen_omz_ir import build_crossroad_like_ir, torch_reference_forward
+
+    size, width, classes = 64, 8, 4
+    xml, weights, meta = build_crossroad_like_ir(
+        tmp_path, input_size=size, width=width, num_classes=classes)
+    model = load_ir(xml)
+    assert model.is_detector and model.detector_kind == "ssd"
+    assert model.num_classes == classes
+    # anchors from the const-folded PriorBoxClustered chain
+    assert model.anchors.shape == (meta["anchors"], 4)
+    np.testing.assert_allclose(model.variances, (0.1, 0.1, 0.2, 0.2),
+                               rtol=1e-6)
+    assert model.output_is_prob == [False, True]  # loc raw, conf softmaxed
+
+    x = np.random.default_rng(2).normal(
+        size=(2, 3, size, size)).astype(np.float32)
+    out = model.forward(model.params, x)
+    ref_loc, ref_conf = torch_reference_forward(weights, x, width, classes)
+    np.testing.assert_allclose(np.asarray(out["loc"]), ref_loc,
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out["conf"]), ref_conf,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_omz_shaped_ssd_serves_through_engine(tmp_path):
+    """The generated OMZ-shaped IR serves through the registry and the
+    fused detect step end-to-end (NHWC frames in, packed rows out)."""
+    import jax
+    import sys as _sys
+    from pathlib import Path as _P
+    _sys.path.insert(0, str(_P(__file__).resolve().parent.parent / "tools"))
+    from gen_omz_ir import build_crossroad_like_ir
+
+    from evam_tpu.engine import steps as step_builders
+    from evam_tpu.models.registry import ModelRegistry
+
+    target = tmp_path / "omz_like" / "1" / "FP32"
+    build_crossroad_like_ir(target, input_size=64, width=8, num_classes=4)
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    model = reg.get("omz_like/1")
+    step = step_builders.build_detect_step(
+        model, max_detections=8, wire_format="bgr", score_threshold=0.0)
+    frames = np.random.default_rng(0).integers(
+        0, 255, (2, 64, 64, 3), np.uint8)
+    packed = np.asarray(jax.jit(step)(model.params, frames))
+    assert packed.shape == (2, 8, 7)
+    assert np.isfinite(packed).all()
