@@ -37,6 +37,13 @@ def cmd_list(args) -> int:
 
 
 def cmd_fetch_models(args) -> int:
+    if args.synthesize_omz:
+        from evam_tpu.models.fetch import synthesize_omz
+
+        return synthesize_omz(
+            args.output, alias=args.synthesize_omz, version=args.version,
+            precision=args.precision, input_size=args.size,
+        )
     if args.from_ir:
         from evam_tpu.models.fetch import import_ir_dir
 
@@ -80,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "into the serving layout instead of zoo export")
     f.add_argument("--alias", default=None,
                    help="serving alias for --from-ir (default: xml stem)")
+    f.add_argument("--synthesize-omz", default=None, metavar="ALIAS",
+                   help="materialize an OMZ-topology-shaped MobileNet-SSD "
+                        "IR under ALIAS (offline stand-in for the OMZ "
+                        "download; see models/ir_build.py)")
+    f.add_argument("--size", type=int, default=512,
+                   help="input resolution for --synthesize-omz")
     f.add_argument("--version", default="1")
     f.add_argument("--precision", default="FP32")
     f.set_defaults(fn=cmd_fetch_models)

@@ -218,3 +218,36 @@ def import_ir_dir(
             xml.name, target, model.input_shape, model.output_names,
         )
     return 1 if failures else 0
+
+
+def synthesize_omz(
+    output: str | Path,
+    alias: str = "omz_like",
+    version: str = "1",
+    precision: str = "FP32",
+    input_size: int = 512,
+    width: int = 32,
+    num_classes: int = 4,
+) -> int:
+    """``fetch-models --synthesize-omz``: materialize an OMZ-shaped
+    MobileNet-SSD IR (models/ir_build.py) into the serving layout.
+
+    The reference's model_downloader needs network access to OMZ;
+    air-gapped deployments (and this environment) get a real IR-backed
+    detector with the same topology shape instead — seeded weights,
+    deterministic, immediately servable. Real IRs installed later via
+    --from-ir simply replace the directory.
+    """
+    from evam_tpu.models.ir import load_ir
+    from evam_tpu.models.ir_build import build_crossroad_like_ir
+
+    target = Path(output) / alias / version / precision
+    xml, _, meta = build_crossroad_like_ir(
+        target, input_size=input_size, width=width, num_classes=num_classes,
+    )
+    model = load_ir(xml)  # fail fast like --from-ir does
+    log.info(
+        "synthesized OMZ-shaped IR %s (input %s, %d anchors) -> %s",
+        alias, model.input_shape, meta["anchors"], target,
+    )
+    return 0

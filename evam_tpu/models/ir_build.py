@@ -1,0 +1,288 @@
+"""Programmatic OpenVINO IR composition (XML + .bin writer).
+
+The inverse of models/ir.py: build a valid IR v11 ``model.xml`` +
+``model.bin`` pair layer by layer. Used by the test suite's golden
+fixtures and by ``fetch-models --synthesize-omz``, which materializes
+an OMZ-topology-shaped MobileNet-SSD (the crossroad-0078 family the
+reference downloads via tools/model_downloader — unavailable here
+with zero egress) so IR-backed serving can be exercised offline.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+class IRBuilder:
+    """Compose a minimal IR v11 xml + bin pair."""
+
+    def __init__(self, name="testnet"):
+        self.name = name
+        self.layers: list[str] = []
+        self.edges: list[str] = []
+        self.blob = bytearray()
+        self._next_id = 0
+
+    def _shape_xml(self, port_id: int, shape) -> str:
+        dims = "".join(f"<dim>{d}</dim>" for d in shape)
+        return f'<port id="{port_id}">{dims}</port>'
+
+    def layer(self, ltype, attrs=None, inputs=(), out_shapes=((),), name=None):
+        """inputs: list of (layer_id, port_id, shape). Returns this
+        layer's id; its output ports are numbered after the inputs."""
+        lid = self._next_id
+        self._next_id += 1
+        name = name or f"{ltype.lower()}_{lid}"
+        attr_xml = ""
+        if attrs:
+            kv = " ".join(f'{k}="{v}"' for k, v in attrs.items())
+            attr_xml = f"<data {kv}/>"
+        in_xml = ""
+        if inputs:
+            ports = "".join(
+                self._shape_xml(i, shp) for i, (_, _, shp) in enumerate(inputs)
+            )
+            in_xml = f"<input>{ports}</input>"
+        first_out = len(inputs)
+        out_xml = "".join(
+            self._shape_xml(first_out + i, s) for i, s in enumerate(out_shapes)
+        )
+        self.layers.append(
+            f'<layer id="{lid}" name="{name}" type="{ltype}" version="opset1">'
+            f"{attr_xml}{in_xml}<output>{out_xml}</output></layer>"
+            if out_shapes
+            else f'<layer id="{lid}" name="{name}" type="{ltype}" '
+            f'version="opset1">{attr_xml}{in_xml}</layer>'
+        )
+        for to_port, (src_lid, src_port, _) in enumerate(inputs):
+            self.edges.append(
+                f'<edge from-layer="{src_lid}" from-port="{src_port}" '
+                f'to-layer="{lid}" to-port="{to_port}"/>'
+            )
+        return lid, first_out
+
+    def const(self, arr: np.ndarray, name=None):
+        arr = np.ascontiguousarray(arr)
+        et = {
+            np.dtype(np.float32): "f32",
+            np.dtype(np.int64): "i64",
+            np.dtype(np.float16): "f16",
+        }[arr.dtype]
+        offset = len(self.blob)
+        self.blob.extend(arr.tobytes())
+        attrs = {
+            "element_type": et,
+            "shape": ",".join(str(d) for d in arr.shape),
+            "offset": offset,
+            "size": arr.nbytes,
+        }
+        return self.layer("Const", attrs, out_shapes=(arr.shape,), name=name)
+
+    def result(self, src):
+        return self.layer("Result", inputs=[src], out_shapes=())
+
+    def write(self, tmpdir: Path, stem="model") -> Path:
+        xml = (
+            f'<?xml version="1.0"?><net name="{self.name}" version="11">'
+            f'<layers>{"".join(self.layers)}</layers>'
+            f'<edges>{"".join(self.edges)}</edges></net>'
+        )
+        xml_path = tmpdir / f"{stem}.xml"
+        xml_path.write_text(xml)
+        (tmpdir / f"{stem}.bin").write_bytes(bytes(self.blob))
+        return xml_path
+
+
+def build_crossroad_like_ir(
+    target: Path,
+    input_size: int = 512,
+    width: int = 8,
+    num_classes: int = 4,
+    seed: int = 20260730,
+):
+    """Write model.xml/.bin; returns (xml_path, weights dict, meta).
+
+    ``width`` is the first pointwise width (real 0078 uses 32); the
+    depthwise ladder is the MobileNet-v1 stride pattern down to /16
+    with SSD heads on the /8 and /16 features.
+    """
+    rng = np.random.default_rng(seed)
+    b = IRBuilder("omz_like_ssd")
+    weights: dict[str, np.ndarray] = {}
+
+    def const(name, arr):
+        weights[name] = arr
+        return b.const(arr, name)
+
+    s = input_size
+    x = b.layer(
+        "Parameter", {"shape": f"1,3,{s},{s}", "element_type": "f32"},
+        out_shapes=((1, 3, s, s),), name="data",
+    )
+    cur, cur_shape = x, (1, 3, s, s)
+
+    def conv(name, out_ch, kernel, stride, groups=1):
+        nonlocal cur, cur_shape
+        _, in_ch, h, w = cur_shape
+        kh = kernel
+        oh, ow = -(-h // stride), -(-w // stride)
+        pad = max((oh - 1) * stride + kh - h, 0)
+        lo, hi = pad // 2, pad - pad // 2
+        if groups == 1:
+            wshape = (out_ch, in_ch, kh, kh)
+            ltype = "Convolution"
+        else:
+            wshape = (groups, 1, 1, kh, kh)
+            ltype = "GroupConvolution"
+        wc = const(f"{name}_w", (rng.normal(size=wshape)
+                                 * (1.5 / np.sqrt(in_ch * kh * kh))
+                                 ).astype(np.float32))
+        out_shape = (1, out_ch, oh, ow)
+        cur = b.layer(
+            ltype,
+            {"strides": f"{stride},{stride}", "pads_begin": f"{lo},{lo}",
+             "pads_end": f"{hi},{hi}", "dilations": "1,1"},
+            inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
+            out_shapes=(out_shape,), name=name,
+        )
+        cur_shape = out_shape
+        bias = const(f"{name}_b", (rng.normal(size=(1, out_ch, 1, 1))
+                                   * 0.1).astype(np.float32))
+        cur = b.layer(
+            "Add", inputs=[(cur[0], cur[1], cur_shape),
+                           (*bias, (1, out_ch, 1, 1))],
+            out_shapes=(cur_shape,), name=f"{name}_bias",
+        )
+        cur = b.layer("ReLU", inputs=[(cur[0], cur[1], cur_shape)],
+                      out_shapes=(cur_shape,), name=f"{name}_relu")
+
+    def dw_block(name, out_ch, stride):
+        in_ch = cur_shape[1]
+        conv(f"{name}_dw", in_ch, 3, stride, groups=in_ch)
+        conv(f"{name}_pw", out_ch, 1, 1)
+
+    # MobileNet-v1 ladder to /16 (trimmed 5x512 repeat to 2 for size)
+    conv("conv0", width, 3, 2)              # /2
+    dw_block("b1", width * 2, 1)
+    dw_block("b2", width * 4, 2)            # /4
+    dw_block("b3", width * 4, 1)
+    dw_block("b4", width * 8, 2)            # /8
+    feat8, feat8_shape = None, None
+    dw_block("b5", width * 8, 1)
+    feat8, feat8_shape = cur, cur_shape
+    dw_block("b6", width * 16, 2)           # /16
+    dw_block("b7", width * 16, 1)
+    feat16, feat16_shape = cur, cur_shape
+
+    # --- SSD heads over the two scales ---
+    anchors_per = 2
+    loc_flats, conf_flats, prior_layers = [], [], []
+    img_shape_c = b.const(np.asarray([s, s], np.int64), "img_shape")
+
+    for idx, (feat, fshape) in enumerate(
+        [(feat8, feat8_shape), (feat16, feat16_shape)]
+    ):
+        _, in_ch, fh, fw = fshape
+        na = anchors_per
+
+        def head(kind, out_ch, last_dims):
+            wc = const(f"head{idx}_{kind}_w",
+                       (rng.normal(size=(out_ch, in_ch, 1, 1))
+                        * (1.0 / np.sqrt(in_ch))).astype(np.float32))
+            hshape = (1, out_ch, fh, fw)
+            h = b.layer(
+                "Convolution",
+                {"strides": "1,1", "pads_begin": "0,0", "pads_end": "0,0",
+                 "dilations": "1,1"},
+                inputs=[(feat[0], feat[1], fshape), (*wc, (out_ch, in_ch, 1, 1))],
+                out_shapes=(hshape,), name=f"head{idx}_{kind}",
+            )
+            perm = b.const(np.asarray([0, 2, 3, 1], np.int64),
+                           f"head{idx}_{kind}_perm")
+            tshape = (1, fh, fw, out_ch)
+            h = b.layer("Transpose",
+                        inputs=[(h[0], h[1], hshape), (*perm, (4,))],
+                        out_shapes=(tshape,), name=f"head{idx}_{kind}_t")
+            tgt = b.const(np.asarray(last_dims, np.int64),
+                          f"head{idx}_{kind}_tgt")
+            fshape_out = tuple(last_dims)
+            h = b.layer("Reshape", {"special_zero": "false"},
+                        inputs=[(h[0], h[1], tshape),
+                                (*tgt, (len(last_dims),))],
+                        out_shapes=(fshape_out,),
+                        name=f"head{idx}_{kind}_flat")
+            return h, fshape_out
+
+        n_cells = fshape[2] * fshape[3]
+        loc, loc_shape = head("loc", na * 4, [1, n_cells * na * 4])
+        loc_flats.append((loc, loc_shape))
+        conf, conf_shape = head(
+            "conf", na * num_classes, [1, n_cells * na, num_classes])
+        sm = b.layer("SoftMax", {"axis": "2"},
+                     inputs=[(conf[0], conf[1], conf_shape)],
+                     out_shapes=(conf_shape,), name=f"head{idx}_conf_sm")
+        tgt2 = b.const(np.asarray([1, n_cells * na * num_classes], np.int64),
+                       f"head{idx}_conf_ftgt")
+        conf_f = b.layer(
+            "Reshape", {"special_zero": "false"},
+            inputs=[(sm[0], sm[1], conf_shape),
+                    (*tgt2, (2,))],
+            out_shapes=((1, n_cells * na * num_classes),),
+            name=f"head{idx}_conf_flat",
+        )
+        conf_flats.append((conf_f, (1, n_cells * na * num_classes)))
+
+        fs_c = b.const(np.asarray([fshape[2], fshape[3]], np.int64),
+                       f"feat_shape{idx}")
+        step = s // fshape[2]
+        pri = b.layer(
+            "PriorBoxClustered",
+            {"width": f"{8.0 * (idx + 1)},{16.0 * (idx + 1)}",
+             "height": f"{16.0 * (idx + 1)},{8.0 * (idx + 1)}",
+             "clip": "false", "step": f"{step}.0", "offset": "0.5",
+             "variance": "0.1,0.1,0.2,0.2"},
+            inputs=[(*fs_c, (2,)), (img_shape_c[0], img_shape_c[1], (2,))],
+            out_shapes=((1, 2, n_cells * na * 4),), name=f"priors{idx}",
+        )
+        prior_layers.append((pri, (1, 2, n_cells * na * 4)))
+
+    total_loc = sum(shp[1] for _, shp in loc_flats)
+    total_conf = sum(shp[1] for _, shp in conf_flats)
+    loc_cat = b.layer(
+        "Concat", {"axis": "1"},
+        inputs=[(l[0], l[1], shp) for l, shp in loc_flats],
+        out_shapes=((1, total_loc),), name="loc_concat",
+    )
+    conf_cat = b.layer(
+        "Concat", {"axis": "1"},
+        inputs=[(c[0], c[1], shp) for c, shp in conf_flats],
+        out_shapes=((1, total_conf),), name="conf_concat",
+    )
+    prior_cat = b.layer(
+        "Concat", {"axis": "2"},
+        inputs=[(p[0], p[1], shp) for p, shp in prior_layers],
+        out_shapes=((1, 2, total_loc),), name="prior_concat",
+    )
+    n_anchors = total_loc // 4
+    det = b.layer(
+        "DetectionOutput",
+        {"num_classes": str(num_classes), "background_label_id": "0",
+         "top_k": "200", "keep_top_k": "200",
+         "code_type": "caffe.PriorBoxParameter.CENTER_SIZE",
+         "share_location": "true", "nms_threshold": "0.45",
+         "confidence_threshold": "0.01",
+         "variance_encoded_in_target": "false", "normalized": "true"},
+        inputs=[(loc_cat[0], loc_cat[1], (1, total_loc)),
+                (conf_cat[0], conf_cat[1], (1, total_conf)),
+                (prior_cat[0], prior_cat[1], (1, 2, total_loc))],
+        out_shapes=((1, 1, 200, 7),), name="detection_out",
+    )
+    b.result((det[0], det[1], (1, 1, 200, 7)))
+
+    target.mkdir(parents=True, exist_ok=True)
+    xml = b.write(target)
+    meta = {"num_classes": num_classes, "anchors": n_anchors,
+            "input_size": input_size, "width": width}
+    return xml, weights, meta

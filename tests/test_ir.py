@@ -15,86 +15,8 @@ import numpy as np
 import pytest
 
 from evam_tpu.models.ir import build_ir_model, load_ir, parse_ir
+from evam_tpu.models.ir_build import IRBuilder
 
-
-class IRBuilder:
-    """Compose a minimal IR v11 xml + bin pair."""
-
-    def __init__(self, name="testnet"):
-        self.name = name
-        self.layers: list[str] = []
-        self.edges: list[str] = []
-        self.blob = bytearray()
-        self._next_id = 0
-
-    def _shape_xml(self, port_id: int, shape) -> str:
-        dims = "".join(f"<dim>{d}</dim>" for d in shape)
-        return f'<port id="{port_id}">{dims}</port>'
-
-    def layer(self, ltype, attrs=None, inputs=(), out_shapes=((),), name=None):
-        """inputs: list of (layer_id, port_id, shape). Returns this
-        layer's id; its output ports are numbered after the inputs."""
-        lid = self._next_id
-        self._next_id += 1
-        name = name or f"{ltype.lower()}_{lid}"
-        attr_xml = ""
-        if attrs:
-            kv = " ".join(f'{k}="{v}"' for k, v in attrs.items())
-            attr_xml = f"<data {kv}/>"
-        in_xml = ""
-        if inputs:
-            ports = "".join(
-                self._shape_xml(i, shp) for i, (_, _, shp) in enumerate(inputs)
-            )
-            in_xml = f"<input>{ports}</input>"
-        first_out = len(inputs)
-        out_xml = "".join(
-            self._shape_xml(first_out + i, s) for i, s in enumerate(out_shapes)
-        )
-        self.layers.append(
-            f'<layer id="{lid}" name="{name}" type="{ltype}" version="opset1">'
-            f"{attr_xml}{in_xml}<output>{out_xml}</output></layer>"
-            if out_shapes
-            else f'<layer id="{lid}" name="{name}" type="{ltype}" '
-            f'version="opset1">{attr_xml}{in_xml}</layer>'
-        )
-        for to_port, (src_lid, src_port, _) in enumerate(inputs):
-            self.edges.append(
-                f'<edge from-layer="{src_lid}" from-port="{src_port}" '
-                f'to-layer="{lid}" to-port="{to_port}"/>'
-            )
-        return lid, first_out
-
-    def const(self, arr: np.ndarray, name=None):
-        arr = np.ascontiguousarray(arr)
-        et = {
-            np.dtype(np.float32): "f32",
-            np.dtype(np.int64): "i64",
-            np.dtype(np.float16): "f16",
-        }[arr.dtype]
-        offset = len(self.blob)
-        self.blob.extend(arr.tobytes())
-        attrs = {
-            "element_type": et,
-            "shape": ",".join(str(d) for d in arr.shape),
-            "offset": offset,
-            "size": arr.nbytes,
-        }
-        return self.layer("Const", attrs, out_shapes=(arr.shape,), name=name)
-
-    def result(self, src):
-        return self.layer("Result", inputs=[src], out_shapes=())
-
-    def write(self, tmpdir: Path, stem="model") -> Path:
-        xml = (
-            f'<?xml version="1.0"?><net name="{self.name}" version="11">'
-            f'<layers>{"".join(self.layers)}</layers>'
-            f'<edges>{"".join(self.edges)}</edges></net>'
-        )
-        xml_path = tmpdir / f"{stem}.xml"
-        xml_path.write_text(xml)
-        (tmpdir / f"{stem}.bin").write_bytes(bytes(self.blob))
-        return xml_path
 
 
 def _build_classifier_ir(tmp_path: Path, out_4d: bool = False):
@@ -914,11 +836,8 @@ def test_omz_shaped_ssd_serves_through_engine(tmp_path):
     """The generated OMZ-shaped IR serves through the registry and the
     fused detect step end-to-end (NHWC frames in, packed rows out)."""
     import jax
-    import sys as _sys
-    from pathlib import Path as _P
-    _sys.path.insert(0, str(_P(__file__).resolve().parent.parent / "tools"))
-    from gen_omz_ir import build_crossroad_like_ir
 
+    from evam_tpu.models.ir_build import build_crossroad_like_ir
     from evam_tpu.engine import steps as step_builders
     from evam_tpu.models.registry import ModelRegistry
 

@@ -172,3 +172,17 @@ def test_parse_model_list_rejects_bad_precision(tmp_path):
     bad.write_text("- model: aclnet\n  precision: [FP13]\n")
     with pytest.raises(ModelListError):
         parse_model_list(bad)
+
+
+def test_fetch_models_synthesize_omz(tmp_path):
+    """fetch-models --synthesize-omz materializes a servable IR dir."""
+    from evam_tpu.models.fetch import synthesize_omz
+    from evam_tpu.models.registry import ModelRegistry
+
+    assert synthesize_omz(tmp_path, alias="offline_det", input_size=64,
+                          width=8) == 0
+    assert (tmp_path / "offline_det" / "1" / "FP32" / "model.xml").exists()
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    m = reg.get("offline_det/1")
+    assert m.ir is not None and m.detector_kind == "ssd"
+    assert m.spec.input_size == (64, 64)

@@ -1,0 +1,91 @@
+"""Does the NCHW IR executor pay a TPU layout penalty vs NHWC flax?
+
+The importer executes IR graphs in their native NCHW layout and lets
+XLA assign internal layouts. If XLA's transposes don't fuse, imported
+real-model serving would be slower than the NHWC zoo path and an
+import-time NHWC rewrite pass would be warranted. This measures the
+same OMZ-shaped MobileNet-SSD (tools/gen_omz_ir.py) as (a) imported IR
+(NCHW) and (b) the equivalent zoo-style NHWC flax net — same weights
+scale, batch 32 at 512².
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+
+def bench_fn(fn, iters=20, warmup=3):
+    import jax
+
+    for i in range(warmup):
+        jax.block_until_ready(fn(np.int32(i)))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(np.int32(100 + i))
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from evam_tpu.models.ir_build import build_crossroad_like_ir
+    from evam_tpu.models.registry import ModelRegistry
+
+    b = int(os.environ.get("EVAM_PROFILE_BATCH", "32"))
+    size, width = 512, 32
+    print(f"device: {jax.devices()[0].platform} batch={b} {size}^2 "
+          f"width={width}", flush=True)
+
+    root = Path(tempfile.mkdtemp())
+    target = root / "omz_like" / "1" / "FP32"
+    build_crossroad_like_ir(target, input_size=size, width=width,
+                            num_classes=4)
+    reg = ModelRegistry(models_dir=root, dtype="bfloat16")
+    ir_model = reg.get("omz_like/1")
+    ir_params = jax.device_put(ir_model.params)
+
+    n = b * size * size * 3
+
+    def synth(seed):
+        i = jax.lax.iota(jnp.uint32, n)
+        bits = i * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+        return ((bits >> 13).astype(jnp.uint8).astype(jnp.float32) / 255.0
+                ).reshape(b, size, size, 3).astype(jnp.bfloat16)
+
+    @jax.jit
+    def ir_fwd(seed):
+        out = ir_model.forward(ir_params, synth(seed))
+        return sum(v.astype(jnp.float32).sum() for v in out.values())
+
+    print(f"IR (NCHW import): {bench_fn(ir_fwd):7.2f} ms", flush=True)
+
+    # NHWC zoo counterpart at the same width
+    from evam_tpu.models.zoo.ssd import SSDDetector
+
+    net = SSDDetector(num_classes=4, width=width, extra_levels=0)
+    params = jax.device_put(
+        net.init(jax.random.PRNGKey(0),
+                 jnp.zeros((1, size, size, 3), jnp.bfloat16)))
+
+    @jax.jit
+    def zoo_fwd(seed):
+        out = net.apply(params, synth(seed))
+        return sum(v.astype(jnp.float32).sum() for v in out.values())
+
+    print(f"zoo (NHWC flax) : {bench_fn(zoo_fwd):7.2f} ms", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
