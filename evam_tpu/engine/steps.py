@@ -254,10 +254,11 @@ def build_action_encode_step(
 def build_action_decode_step(model: LoadedModel) -> Callable:
     """Embedding clips [B,T,D] float32 → class probabilities [B,C]."""
     forward = model.forward
+    is_prob = model.out_is_prob  # IR graphs may softmax in-graph
 
     def step(params, clips):
-        logits = forward(params, clips)
-        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = forward(params, clips).astype(jnp.float32)
+        return out if is_prob else jax.nn.softmax(out, axis=-1)
 
     return step
 
@@ -270,10 +271,11 @@ def build_audio_step(model: LoadedModel) -> Callable:
     pipelines/audio_detection/environment/pipeline.json:5).
     """
     forward = model.forward
+    is_prob = model.out_is_prob  # IR graphs may softmax in-graph
 
     def step(params, windows):
         x = windows.astype(jnp.float32) / 32768.0
-        logits = forward(params, x)
-        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = forward(params, x).astype(jnp.float32)
+        return out if is_prob else jax.nn.softmax(out, axis=-1)
 
     return step

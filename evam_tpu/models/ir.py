@@ -1120,7 +1120,12 @@ class ImportedIRModel:
 
     @property
     def input_hw(self) -> tuple[int, int]:
-        return (int(self.input_shape[2]), int(self.input_shape[3]))
+        if len(self.input_shape) == 4:
+            return (int(self.input_shape[2]), int(self.input_shape[3]))
+        # non-image IR (clip embeddings [1,T,D], audio [1,S]): the
+        # registry uses this only to fill the PreprocessSpec, which
+        # those families never apply — report the trailing dims
+        return (1, int(self.input_shape[-1]))
 
 
 def _sanitize(name: str) -> str:

@@ -137,6 +137,10 @@ class LoadedModel:
     #: "ssd" (loc/conf + anchors) or "yolo" (RegionYolo grid maps,
     #: decoded by ops.boxes.yolo_gather inside the engine step)
     detector_kind: str = "ssd"
+    #: single-array-output models (action decoder / aclnet): True when
+    #: the graph already ends in SoftMax — engine steps must not
+    #: re-softmax (same contract as conf_is_prob / head_is_prob)
+    out_is_prob: bool = False
     #: per YOLO head: {"anchors": [[w,h]...] in input pixels}
     yolo_specs: list = field(default_factory=list)
     #: set when backed by an imported OpenVINO IR graph (models/ir.py)
@@ -168,17 +172,33 @@ class LoadedModel:
         # flip to RGB) — the luma weights must follow it
         rgb_order = self.preprocess.color_space.upper() == "RGB"
 
+        #: families whose engine steps consume a single raw array
+        #: (build_action_decode_step / build_audio_step /
+        #: build_action_encode_step), not the classifier head dict
+        array_out = self.spec.family in (
+            "action_decoder", "action_encoder", "aclnet"
+        )
+
         def fn(params, batch):
-            if in_channels == 1 and batch.shape[-1] == 3:
-                # grayscale-input IR (some OMZ nets): BT.601 luma in
-                # the delivered channel order
-                w601 = jnp.asarray(
-                    [0.299, 0.587, 0.114] if rgb_order
-                    else [0.114, 0.587, 0.299],
-                    batch.dtype,
+            if len(ir.input_shape) == 4 and batch.ndim == 4:
+                # image input: engine feeds NHWC, IR convention is NCHW
+                if in_channels == 1 and batch.shape[-1] == 3:
+                    # grayscale-input IR (some OMZ nets): BT.601 luma
+                    # in the delivered channel order
+                    w601 = jnp.asarray(
+                        [0.299, 0.587, 0.114] if rgb_order
+                        else [0.114, 0.587, 0.299],
+                        batch.dtype,
+                    )
+                    batch = (batch * w601).sum(axis=-1, keepdims=True)
+                x = jnp.transpose(batch, (0, 3, 1, 2))
+            else:
+                # non-image input (clip embeddings [B,T,D], audio
+                # windows [B,S]): conform to the IR's declared rank
+                x = batch.reshape(
+                    (batch.shape[0],)
+                    + tuple(int(d) for d in ir.input_shape[1:])
                 )
-                batch = (batch * w601).sum(axis=-1, keepdims=True)
-            x = jnp.transpose(batch, (0, 3, 1, 2))
             out = ir.forward(params, x)
             if ir.detector_kind == "yolo":
                 # raw NCHW grid maps, decoded in the engine step
@@ -190,6 +210,15 @@ class LoadedModel:
                     "loc": out["loc"].reshape(b, -1, 4),
                     "conf": out["conf"].reshape(b, -1, num_classes),
                 }
+            if array_out:
+                if len(out) != 1:
+                    raise ValueError(
+                        f"{self.spec.key}: {self.spec.family} IR must "
+                        f"have exactly one output, got {list(out)} — "
+                        "an auxiliary Result would be served silently"
+                    )
+                sole = next(iter(out.values()))
+                return sole.reshape(sole.shape[0], -1)
             return {k: v.reshape(v.shape[0], -1) for k, v in out.items()}
 
         return fn
@@ -373,6 +402,20 @@ class ModelRegistry:
             family = "ssd"
             num_classes = ir_model.num_classes or (base.num_classes if base else 2)
             heads: tuple = ()
+        elif base is not None and base.family in (
+            "action_decoder", "action_encoder", "aclnet"
+        ):
+            # IR installed under a temporal/audio alias serves that
+            # family's engine step (raw-array contract) — e.g. the OMZ
+            # action-recognition-0001 decoder's TensorIterator/LSTM IR
+            family = base.family
+            heads = ()
+            if family == "action_encoder" or not ir_model.output_shapes:
+                num_classes = base.num_classes  # encoder output = embedding
+            else:
+                # class count from the installed IR, not the zoo spec —
+                # a fine-tuned decoder may have a different width
+                num_classes = int(np.prod(ir_model.output_shapes[0][1:]))
         else:
             family = "classifier"
             num_classes = base.num_classes if base else 0
@@ -447,6 +490,9 @@ class ModelRegistry:
             variances=ir_model.variances,
             conf_is_prob=probs.get("conf", False),
             head_is_prob=probs,
+            out_is_prob=bool(
+                ir_model.output_is_prob and ir_model.output_is_prob[0]
+            ),
             detector_kind=ir_model.detector_kind,
             yolo_specs=list(ir_model.yolo_specs),
             ir=ir_model,

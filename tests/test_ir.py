@@ -852,3 +852,163 @@ def test_omz_shaped_ssd_serves_through_engine(tmp_path):
     packed = np.asarray(jax.jit(step)(model.params, frames))
     assert packed.shape == (2, 8, 7)
     assert np.isfinite(packed).all()
+
+
+def test_ir_action_decoder_serves(tmp_path):
+    """An IR recurrent decoder (clips [1,T,D] → TensorIterator/LSTM →
+    last hidden → FC logits) installed under the action decoder alias
+    serves through build_action_decode_step — the OMZ
+    action-recognition-0001-decoder shape."""
+    import jax
+
+    from evam_tpu.engine.steps import build_action_decode_step
+    from evam_tpu.models.registry import ModelRegistry
+
+    rng = np.random.default_rng(21)
+    t, d, hs, classes = 16, 512, 8, 400
+    w = (rng.normal(size=(4 * hs, d)) * 0.1).astype(np.float32)
+    r = (rng.normal(size=(4 * hs, hs)) * 0.1).astype(np.float32)
+    bias = np.zeros((4 * hs,), np.float32)
+    fc = (rng.normal(size=(hs, classes)) * 0.1).astype(np.float32)
+
+    body = IRBuilder("dbody")
+    bx = body.layer("Parameter", {"shape": f"1,1,{d}", "element_type": "f32"},
+                    out_shapes=((1, 1, d),), name="xt")
+    bh = body.layer("Parameter", {"shape": f"1,{hs}", "element_type": "f32"},
+                    out_shapes=((1, hs),), name="h_in")
+    bc_ = body.layer("Parameter", {"shape": f"1,{hs}", "element_type": "f32"},
+                     out_shapes=((1, hs),), name="c_in")
+    axes = body.const(np.asarray([1], np.int64), "sq_axes")
+    sq = body.layer("Squeeze",
+                    inputs=[(bx[0], bx[1], (1, 1, d)), (*axes, (1,))],
+                    out_shapes=((1, d),), name="squeeze")
+    wc = body.const(w, "W")
+    rc = body.const(r, "R")
+    bbc = body.const(bias, "B")
+    cell = body.layer(
+        "LSTMCell", {"hidden_size": str(hs)},
+        inputs=[(sq[0], sq[1], (1, d)), (bh[0], bh[1], (1, hs)),
+                (bc_[0], bc_[1], (1, hs)), (*wc, w.shape), (*rc, r.shape),
+                (*bbc, bias.shape)],
+        out_shapes=((1, hs), (1, hs)), name="cell",
+    )
+    r_h = body.result((cell[0], cell[1], (1, hs)))
+    r_c = body.result((cell[0], cell[1] + 1, (1, hs)))
+    body_xml = (f'<layers>{"".join(body.layers)}</layers>'
+                f'<edges>{"".join(body.edges)}</edges>')
+
+    b = IRBuilder("action_dec")
+    b.blob = body.blob
+    b._next_id = 100
+    x = b.layer("Parameter", {"shape": f"1,{t},{d}", "element_type": "f32"},
+                out_shapes=((1, t, d),), name="input")
+    h0 = b.const(np.zeros((1, hs), np.float32), "h0")
+    c0 = b.const(np.zeros((1, hs), np.float32), "c0")
+    ti_id = b._next_id
+    b._next_id += 1
+    b.layers.append(
+        f'<layer id="{ti_id}" name="ti" type="TensorIterator" version="opset1">'
+        '<input>'
+        f'<port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        f'<port id="1"><dim>1</dim><dim>{hs}</dim></port>'
+        f'<port id="2"><dim>1</dim><dim>{hs}</dim></port>'
+        '</input><output>'
+        f'<port id="3"><dim>1</dim><dim>{hs}</dim></port>'
+        '</output>'
+        '<port_map>'
+        f'<input external_port_id="0" internal_layer_id="{bx[0]}" '
+        'axis="1" stride="1" start="0"/>'
+        f'<input external_port_id="1" internal_layer_id="{bh[0]}"/>'
+        f'<input external_port_id="2" internal_layer_id="{bc_[0]}"/>'
+        f'<output external_port_id="3" internal_layer_id="{r_h[0]}"/>'
+        '</port_map>'
+        '<back_edges>'
+        f'<edge from-layer="{r_h[0]}" to-layer="{bh[0]}"/>'
+        f'<edge from-layer="{r_c[0]}" to-layer="{bc_[0]}"/>'
+        '</back_edges>'
+        f'<body>{body_xml}</body>'
+        '</layer>'
+    )
+    for to_port, (src_lid, src_port) in enumerate(
+        [(x[0], x[1]), h0[:2], c0[:2]]
+    ):
+        b.edges.append(
+            f'<edge from-layer="{src_lid}" from-port="{src_port}" '
+            f'to-layer="{ti_id}" to-port="{to_port}"/>'
+        )
+    fc_c = b.const(fc, "fc_w")
+    mm_id = b._next_id
+    b._next_id += 1
+    b.layers.append(
+        f'<layer id="{mm_id}" name="logits" type="MatMul" version="opset1">'
+        '<data transpose_a="false" transpose_b="false"/>'
+        f'<input><port id="0"><dim>1</dim><dim>{hs}</dim></port>'
+        f'<port id="1"><dim>{hs}</dim><dim>{classes}</dim></port></input>'
+        f'<output><port id="2"><dim>1</dim><dim>{classes}</dim></port>'
+        '</output></layer>'
+    )
+    b.edges.append(f'<edge from-layer="{ti_id}" from-port="3" '
+                   f'to-layer="{mm_id}" to-port="0"/>')
+    b.edges.append(f'<edge from-layer="{fc_c[0]}" from-port="{fc_c[1]}" '
+                   f'to-layer="{mm_id}" to-port="1"/>')
+    b.layers.append(
+        '<layer id="300" name="res" type="Result" version="opset1">'
+        f'<input><port id="0"><dim>1</dim><dim>{classes}</dim></port>'
+        '</input></layer>'
+    )
+    b.edges.append(f'<edge from-layer="{mm_id}" from-port="2" '
+                   'to-layer="300" to-port="0"/>')
+
+    target = tmp_path / "action_recognition" / "decoder" / "FP32"
+    target.mkdir(parents=True)
+    b.write(target)
+
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    m = reg.get("action_recognition/decoder")
+    assert m.spec.family == "action_decoder"
+    assert m.spec.num_classes == classes
+
+    step = jax.jit(build_action_decode_step(m))
+    clips = rng.normal(size=(2, t, d)).astype(np.float32)
+    probs = np.asarray(step(m.params, clips))
+    assert probs.shape == (2, classes)
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-4)
+    # batch rows are independent: row 1 with different input differs
+    assert not np.allclose(probs[0], probs[1])
+
+
+def test_yolo_detect_classify_fused(tmp_path):
+    """A yolo IR detector composes with the zoo classifier in the
+    fused detect+classify step (ROI crops from the wire planes,
+    object-class filter on 1-based yolo labels)."""
+    import jax
+
+    from evam_tpu.engine.steps import build_detect_classify_step
+    from evam_tpu.models.registry import ModelRegistry
+    from evam_tpu.ops.color import bgr_to_i420_host
+
+    target = tmp_path / "ir_yolo" / "1" / "FP32"
+    target.mkdir(parents=True)
+    _build_yolo_ir(target)
+    reg = ModelRegistry(models_dir=tmp_path, dtype="float32")
+    det = reg.get("ir_yolo/1")
+    cls = reg.get("object_classification/vehicle_attributes")
+
+    step = jax.jit(build_detect_classify_step(
+        det, cls, max_detections=4, roi_budget=2, wire_format="i420",
+        score_threshold=0.0, allowed_label_ids=(1,),
+    ))
+    frames = np.stack([
+        bgr_to_i420_host(np.random.default_rng(i).integers(
+            0, 255, (8, 8, 3), np.uint8))
+        for i in range(2)
+    ])
+    params = {"det": det.params, "cls": cls.params}
+    out = np.asarray(step(params, frames))
+    head_total = sum(n for _, n in cls.spec.heads)
+    assert out.shape == (2, 4, 7 + head_total)
+    assert np.isfinite(out).all()
+    # classified rows carry softmaxed head blocks (sum = #heads)
+    probs = out[..., 7:]
+    sums = probs.sum(axis=-1)
+    assert ((np.abs(sums - len(cls.spec.heads)) < 1e-3) | (sums == 0.0)).all()
