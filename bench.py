@@ -123,6 +123,16 @@ def main() -> int:
     p.add_argument("--probe-timeout", type=float, default=150.0,
                    help="seconds to wait for the device-probe subprocess")
     p.add_argument("--skip-probe", action="store_true")
+    p.add_argument("--models-dir", default=None,
+                   help="serving-layout model directory (e.g. installed "
+                        "via fetch-models --from-ir / --synthesize-omz) — "
+                        "bench real IR-backed models instead of the zoo")
+    p.add_argument("--det-model", default="object_detection/person_vehicle_bike",
+                   help="registry key for the detector under --config "
+                        "detect/detect_classify")
+    p.add_argument("--cls-model", default="object_classification/vehicle_attributes",
+                   help="registry key for the classifier under --config "
+                        "detect_classify")
     p.add_argument("--precision", choices=["bf16", "int8"], default="bf16",
                    help="int8: quantized module variants on the int8 MXU "
                    "path (weights stay float; ops/qlinear.py)")
@@ -162,17 +172,18 @@ def main() -> int:
     log(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}")
 
     registry = ModelRegistry(
+        models_dir=args.models_dir,
         dtype="int8" if args.precision == "int8" else "bfloat16")
     b, h, w = args.batch, args.height, args.width
     if args.config == "detect_classify":
-        det = registry.get("object_detection/person_vehicle_bike")
-        cls = registry.get("object_classification/vehicle_attributes")
+        det = registry.get(args.det_model)
+        cls = registry.get(args.cls_model)
         step = step_builders.build_detect_classify_step(
             det, cls, wire_format=args.wire
         )
         params = {"det": det.params, "cls": cls.params}
     elif args.config == "detect":
-        det = registry.get("object_detection/person_vehicle_bike")
+        det = registry.get(args.det_model)
         step = step_builders.build_detect_step(det, wire_format=args.wire)
         params = det.params
     elif args.config == "action":

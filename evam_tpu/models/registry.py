@@ -410,6 +410,13 @@ class ModelRegistry:
             # action-recognition-0001 decoder's TensorIterator/LSTM IR
             family = base.family
             heads = ()
+            if len(ir_model.output_names) != 1:
+                # fail at load time, not at the first engine trace —
+                # and never pick metadata off an auxiliary output
+                raise ValueError(
+                    f"{key}: a {family} IR must have exactly one "
+                    f"output, got {ir_model.output_names}"
+                )
             if family == "action_encoder" or not ir_model.output_shapes:
                 num_classes = base.num_classes  # encoder output = embedding
             else:
