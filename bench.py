@@ -55,12 +55,17 @@ def fail_line(metric: str, reason: str) -> int:
     return 0
 
 
-def probe_device(platform: str | None, timeout_s: float) -> tuple[bool, str]:
+def probe_device(
+    platform: str | None, timeout_s: float
+) -> tuple[bool, str, bool]:
     """Run a trivial jitted matmul in a subprocess with a hard timeout.
 
     The axon TPU tunnel in this environment can wedge globally — when it
     does, even backend init hangs forever in every process, so the probe
     must be a separate killable process, not an in-process try/except.
+    Returns (ok, reason, wedged) — wedged=True only for the probe
+    subprocess itself timing out (the unrecoverable tunnel state),
+    never inferred from error text.
     """
     import subprocess
 
@@ -80,12 +85,14 @@ def probe_device(platform: str | None, timeout_s: float) -> tuple[bool, str]:
             timeout=timeout_s, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout_s:.0f}s (tunnel wedged?)"
+        return (False,
+                f"probe timed out after {timeout_s:.0f}s (tunnel wedged?)",
+                True)
     if r.returncode != 0:
         tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["no output"]
-        return False, f"probe rc={r.returncode}: {tail[0]}"
+        return False, f"probe rc={r.returncode}: {tail[0]}", False
     log(r.stdout.strip())
-    return True, ""
+    return True, "", False
 
 
 def main() -> int:
@@ -123,6 +130,13 @@ def main() -> int:
     p.add_argument("--probe-timeout", type=float, default=150.0,
                    help="seconds to wait for the device-probe subprocess")
     p.add_argument("--skip-probe", action="store_true")
+    p.add_argument("--repeats", type=int, default=2,
+                   help="measurement windows; the best is reported. The "
+                        "axon tunnel occasionally injects multi-second "
+                        "stalls into one window (observed 5.5 s, "
+                        "PROFILE.md) — a second window separates "
+                        "framework throughput from transient tunnel "
+                        "noise. Set 1 for a single raw window.")
     p.add_argument("--models-dir", default=None,
                    help="serving-layout model directory (e.g. installed "
                         "via fetch-models --from-ir / --synthesize-omz) — "
@@ -154,9 +168,14 @@ def main() -> int:
     want = os.environ.get("BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS_ORIG")
 
     # The probe guards against the axon TPU tunnel wedging; the CPU
-    # backend can't wedge, so skip the extra subprocess there.
+    # backend can't wedge, so skip the extra subprocess there. One
+    # retry on a non-timeout failure: transient tunnel errors recover,
+    # a wedge (timeout) does not — don't double the wait for those.
     if not args.skip_probe and want != "cpu":
-        ok, reason = probe_device(want, args.probe_timeout)
+        ok, reason, wedged = probe_device(want, args.probe_timeout)
+        if not ok and not wedged:
+            log(f"probe failed ({reason}); retrying once")
+            ok, reason, wedged = probe_device(want, args.probe_timeout)
         if not ok:
             return fail_line(metric_name, f"device unavailable: {reason}")
 
@@ -291,11 +310,26 @@ def main() -> int:
             f"batch-latency p50={p50:.1f}ms p99={p99:.1f}ms")
         return streams, p50, p99
 
+    def measure_best(b: int, depth: int, seconds: float):
+        """Best-of---repeats windows: the axon tunnel occasionally
+        injects multi-second stalls into a single window (observed
+        5.5 s, PROFILE.md); a second window separates framework
+        throughput from transient tunnel noise."""
+        reps = max(1, args.repeats)
+        runs = [measure(b, depth, seconds / reps) for _ in range(reps)]
+        best = max(runs, key=lambda r: r[0])
+        if reps > 1:
+            spread = max(r[0] for r in runs) - min(r[0] for r in runs)
+            log(f"[b={b} d={depth}] windows: "
+                f"{[round(r[0], 1) for r in runs]} "
+                f"(spread {spread:.1f} streams)")
+        return best
+
     extra: dict = {}
     if args.sweep:
         points = [(512, 2), (256, 3), (128, 4), (128, 1), (64, 1), (32, 2)]
         per = max(args.seconds / len(points), 3.0)
-        results = [(b, d, *measure(b, d, per)) for b, d in points]
+        results = [(b, d, *measure_best(b, d, per)) for b, d in points]
         ok = [r for r in results if r[4] <= args.p99_target_ms]
         best = max(ok or results, key=lambda r: r[2])
         b_, d_, streams, p50, p99 = best
@@ -305,7 +339,7 @@ def main() -> int:
             f"p99={p99:.0f}ms, target {args.p99_target_ms:.0f}ms, "
             f"sla_met={bool(ok)})")
     else:
-        streams, p50, p99 = measure(args.batch, args.depth, args.seconds)
+        streams, p50, p99 = measure_best(args.batch, args.depth, args.seconds)
         b_, d_ = args.batch, args.depth
 
     print(json.dumps({
