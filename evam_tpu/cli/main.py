@@ -43,6 +43,7 @@ def cmd_fetch_models(args) -> int:
         return synthesize_omz(
             args.output, alias=args.synthesize_omz, version=args.version,
             precision=args.precision, input_size=args.size,
+            topology=args.topology,
         )
     if args.from_ir:
         from evam_tpu.models.fetch import import_ir_dir
@@ -91,8 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="materialize an OMZ-topology-shaped MobileNet-SSD "
                         "IR under ALIAS (offline stand-in for the OMZ "
                         "download; see models/ir_build.py)")
-    f.add_argument("--size", type=int, default=512,
-                   help="input resolution for --synthesize-omz")
+    f.add_argument("--size", type=int, default=None,
+                   help="input resolution for --synthesize-omz "
+                        "(default: 512 for ssd, 72 for attributes)")
+    f.add_argument("--topology", choices=["ssd", "attributes"],
+                   default="ssd",
+                   help="--synthesize-omz topology: MobileNet-SSD "
+                        "detector or multi-head attributes classifier")
     f.add_argument("--version", default="1")
     f.add_argument("--precision", default="FP32")
     f.set_defaults(fn=cmd_fetch_models)

@@ -225,29 +225,45 @@ def synthesize_omz(
     alias: str = "omz_like",
     version: str = "1",
     precision: str = "FP32",
-    input_size: int = 512,
-    width: int = 32,
+    input_size: int | None = None,
+    width: int | None = None,
     num_classes: int = 4,
+    topology: str = "ssd",
 ) -> int:
-    """``fetch-models --synthesize-omz``: materialize an OMZ-shaped
-    MobileNet-SSD IR (models/ir_build.py) into the serving layout.
+    """``fetch-models --synthesize-omz``: materialize an OMZ-shaped IR
+    (models/ir_build.py) into the serving layout.
 
     The reference's model_downloader needs network access to OMZ;
     air-gapped deployments (and this environment) get a real IR-backed
-    detector with the same topology shape instead — seeded weights,
-    deterministic, immediately servable. Real IRs installed later via
-    --from-ir simply replace the directory.
+    model with the same topology shape instead — seeded weights,
+    deterministic, immediately servable. ``topology``: "ssd"
+    (crossroad-0078-shaped MobileNet-SSD detector) or "attributes"
+    (vehicle-attributes-shaped multi-head classifier). Real IRs
+    installed later via --from-ir simply replace the directory.
     """
     from evam_tpu.models.ir import load_ir
-    from evam_tpu.models.ir_build import build_crossroad_like_ir
+    from evam_tpu.models.ir_build import (
+        build_attributes_like_ir,
+        build_crossroad_like_ir,
+    )
 
     target = Path(output) / alias / version / precision
-    xml, _, meta = build_crossroad_like_ir(
-        target, input_size=input_size, width=width, num_classes=num_classes,
-    )
+    if topology == "attributes":
+        xml, _, meta = build_attributes_like_ir(
+            target, input_size=input_size or 72, width=width or 16,
+        )
+        note = f"heads {meta['heads']}"
+    elif topology == "ssd":
+        xml, _, meta = build_crossroad_like_ir(
+            target, input_size=input_size or 512, width=width or 32,
+            num_classes=num_classes,
+        )
+        note = f"{meta['anchors']} anchors"
+    else:
+        raise ValueError(f"unknown topology {topology!r} (ssd|attributes)")
     model = load_ir(xml)  # fail fast like --from-ir does
     log.info(
-        "synthesized OMZ-shaped IR %s (input %s, %d anchors) -> %s",
-        alias, model.input_shape, meta["anchors"], target,
+        "synthesized OMZ-shaped IR %s (input %s, %s) -> %s",
+        alias, model.input_shape, note, target,
     )
     return 0

@@ -286,3 +286,99 @@ def build_crossroad_like_ir(
     meta = {"num_classes": num_classes, "anchors": n_anchors,
             "input_size": input_size, "width": width}
     return xml, weights, meta
+
+
+def build_attributes_like_ir(
+    target: Path,
+    input_size: int = 72,
+    width: int = 16,
+    heads: tuple = (("color", 7), ("type", 4)),
+    seed: int = 20260731,
+):
+    """Write a vehicle-attributes-shaped multi-head classifier IR.
+
+    The OMZ topology shape the reference's gvaclassify serves
+    (vehicle-attributes-recognition-barrier-0039: small conv ladder,
+    per-head 1x1 conv + global pool + SoftMax). Head layer names equal
+    the head names so zoo head-label metadata binds when installed
+    under the matching alias. Returns (xml_path, weights, meta).
+    """
+    rng = np.random.default_rng(seed)
+    b = IRBuilder("attributes_like")
+    weights: dict[str, np.ndarray] = {}
+
+    def const(name, arr):
+        weights[name] = arr
+        return b.const(arr, name)
+
+    s = input_size
+    x = b.layer("Parameter", {"shape": f"1,3,{s},{s}", "element_type": "f32"},
+                out_shapes=((1, 3, s, s),), name="data")
+    cur, cur_shape = x, (1, 3, s, s)
+
+    def conv(name, out_ch, kernel, stride):
+        nonlocal cur, cur_shape
+        _, in_ch, h, w = cur_shape
+        oh, ow = -(-h // stride), -(-w // stride)
+        pad = max((oh - 1) * stride + kernel - h, 0)
+        lo, hi = pad // 2, pad - pad // 2
+        wshape = (out_ch, in_ch, kernel, kernel)
+        wc = const(f"{name}_w", (rng.normal(size=wshape)
+                                 * (1.5 / np.sqrt(in_ch * kernel * kernel))
+                                 ).astype(np.float32))
+        out_shape = (1, out_ch, oh, ow)
+        cur = b.layer(
+            "Convolution",
+            {"strides": f"{stride},{stride}", "pads_begin": f"{lo},{lo}",
+             "pads_end": f"{hi},{hi}", "dilations": "1,1"},
+            inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
+            out_shapes=(out_shape,), name=name,
+        )
+        cur_shape = out_shape
+        bias = const(f"{name}_b", (rng.normal(size=(1, out_ch, 1, 1))
+                                   * 0.1).astype(np.float32))
+        cur = b.layer("Add", inputs=[(cur[0], cur[1], cur_shape),
+                                     (*bias, (1, out_ch, 1, 1))],
+                      out_shapes=(cur_shape,), name=f"{name}_bias")
+        cur = b.layer("ReLU", inputs=[(cur[0], cur[1], cur_shape)],
+                      out_shapes=(cur_shape,), name=f"{name}_relu")
+
+    conv("c1", width, 3, 2)
+    conv("c2", width * 2, 3, 2)
+    conv("c3", width * 4, 3, 2)
+    trunk, trunk_shape = cur, cur_shape
+    _, tc, th, tw_ = trunk_shape
+
+    for hname, classes in heads:
+        wshape = (classes, tc, 1, 1)
+        wc = const(f"{hname}_w", (rng.normal(size=wshape)
+                                  * (1.0 / np.sqrt(tc))).astype(np.float32))
+        hshape = (1, classes, th, tw_)
+        h = b.layer(
+            "Convolution",
+            {"strides": "1,1", "pads_begin": "0,0", "pads_end": "0,0",
+             "dilations": "1,1"},
+            inputs=[(trunk[0], trunk[1], trunk_shape), (*wc, wshape)],
+            out_shapes=(hshape,), name=f"{hname}_conv",
+        )
+        pool = b.layer(
+            "AvgPool",
+            {"kernel": f"{th},{tw_}", "strides": "1,1", "pads_begin": "0,0",
+             "pads_end": "0,0", "exclude-pad": "true"},
+            inputs=[(h[0], h[1], hshape)],
+            out_shapes=((1, classes, 1, 1),), name=f"{hname}_pool",
+        )
+        tgt = b.const(np.asarray([1, classes], np.int64), f"{hname}_tgt")
+        flat = b.layer("Reshape", {"special_zero": "false"},
+                       inputs=[(pool[0], pool[1], (1, classes, 1, 1)),
+                               (*tgt, (2,))],
+                       out_shapes=((1, classes),), name=f"{hname}_flat")
+        sm = b.layer("SoftMax", {"axis": "1"},
+                     inputs=[(flat[0], flat[1], (1, classes))],
+                     out_shapes=((1, classes),), name=hname)
+        b.result((sm[0], sm[1], (1, classes)))
+
+    target.mkdir(parents=True, exist_ok=True)
+    xml = b.write(target)
+    return xml, weights, {"heads": tuple(heads), "input_size": input_size,
+                          "width": width}
