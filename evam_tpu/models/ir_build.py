@@ -95,6 +95,57 @@ class IRBuilder:
         return xml_path
 
 
+
+def conv_bias_relu(
+    b: "IRBuilder",
+    weights: dict,
+    rng,
+    cur,
+    cur_shape: tuple,
+    name: str,
+    out_ch: int,
+    kernel: int,
+    stride: int,
+    groups: int = 1,
+):
+    """Append Convolution/GroupConvolution + bias Add + ReLU (the OMZ
+    conv block both generated topologies use) and register the weight
+    tensors. Returns (layer_ref, out_shape)."""
+    _, in_ch, h, w = cur_shape
+    oh, ow = -(-h // stride), -(-w // stride)
+    pad = max((oh - 1) * stride + kernel - h, 0)
+    lo, hi = pad // 2, pad - pad // 2
+    if groups == 1:
+        wshape = (out_ch, in_ch, kernel, kernel)
+        ltype = "Convolution"
+    else:
+        wshape = (groups, 1, 1, kernel, kernel)
+        ltype = "GroupConvolution"
+    warr = (rng.normal(size=wshape)
+            * (1.5 / np.sqrt(in_ch * kernel * kernel))).astype(np.float32)
+    weights[f"{name}_w"] = warr
+    wc = b.const(warr, f"{name}_w")
+    out_shape = (1, out_ch, oh, ow)
+    cur = b.layer(
+        ltype,
+        {"strides": f"{stride},{stride}", "pads_begin": f"{lo},{lo}",
+         "pads_end": f"{hi},{hi}", "dilations": "1,1"},
+        inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
+        out_shapes=(out_shape,), name=name,
+    )
+    barr = (rng.normal(size=(1, out_ch, 1, 1)) * 0.1).astype(np.float32)
+    weights[f"{name}_b"] = barr
+    bias = b.const(barr, f"{name}_b")
+    cur = b.layer(
+        "Add", inputs=[(cur[0], cur[1], out_shape),
+                       (*bias, (1, out_ch, 1, 1))],
+        out_shapes=(out_shape,), name=f"{name}_bias",
+    )
+    cur = b.layer("ReLU", inputs=[(cur[0], cur[1], out_shape)],
+                  out_shapes=(out_shape,), name=f"{name}_relu")
+    return cur, out_shape
+
+
 def build_crossroad_like_ir(
     target: Path,
     input_size: int = 512,
@@ -125,38 +176,10 @@ def build_crossroad_like_ir(
 
     def conv(name, out_ch, kernel, stride, groups=1):
         nonlocal cur, cur_shape
-        _, in_ch, h, w = cur_shape
-        kh = kernel
-        oh, ow = -(-h // stride), -(-w // stride)
-        pad = max((oh - 1) * stride + kh - h, 0)
-        lo, hi = pad // 2, pad - pad // 2
-        if groups == 1:
-            wshape = (out_ch, in_ch, kh, kh)
-            ltype = "Convolution"
-        else:
-            wshape = (groups, 1, 1, kh, kh)
-            ltype = "GroupConvolution"
-        wc = const(f"{name}_w", (rng.normal(size=wshape)
-                                 * (1.5 / np.sqrt(in_ch * kh * kh))
-                                 ).astype(np.float32))
-        out_shape = (1, out_ch, oh, ow)
-        cur = b.layer(
-            ltype,
-            {"strides": f"{stride},{stride}", "pads_begin": f"{lo},{lo}",
-             "pads_end": f"{hi},{hi}", "dilations": "1,1"},
-            inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
-            out_shapes=(out_shape,), name=name,
+        cur, cur_shape = conv_bias_relu(
+            b, weights, rng, cur, cur_shape, name, out_ch, kernel,
+            stride, groups,
         )
-        cur_shape = out_shape
-        bias = const(f"{name}_b", (rng.normal(size=(1, out_ch, 1, 1))
-                                   * 0.1).astype(np.float32))
-        cur = b.layer(
-            "Add", inputs=[(cur[0], cur[1], cur_shape),
-                           (*bias, (1, out_ch, 1, 1))],
-            out_shapes=(cur_shape,), name=f"{name}_bias",
-        )
-        cur = b.layer("ReLU", inputs=[(cur[0], cur[1], cur_shape)],
-                      out_shapes=(cur_shape,), name=f"{name}_relu")
 
     def dw_block(name, out_ch, stride):
         in_ch = cur_shape[1]
@@ -318,30 +341,9 @@ def build_attributes_like_ir(
 
     def conv(name, out_ch, kernel, stride):
         nonlocal cur, cur_shape
-        _, in_ch, h, w = cur_shape
-        oh, ow = -(-h // stride), -(-w // stride)
-        pad = max((oh - 1) * stride + kernel - h, 0)
-        lo, hi = pad // 2, pad - pad // 2
-        wshape = (out_ch, in_ch, kernel, kernel)
-        wc = const(f"{name}_w", (rng.normal(size=wshape)
-                                 * (1.5 / np.sqrt(in_ch * kernel * kernel))
-                                 ).astype(np.float32))
-        out_shape = (1, out_ch, oh, ow)
-        cur = b.layer(
-            "Convolution",
-            {"strides": f"{stride},{stride}", "pads_begin": f"{lo},{lo}",
-             "pads_end": f"{hi},{hi}", "dilations": "1,1"},
-            inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
-            out_shapes=(out_shape,), name=name,
+        cur, cur_shape = conv_bias_relu(
+            b, weights, rng, cur, cur_shape, name, out_ch, kernel, stride,
         )
-        cur_shape = out_shape
-        bias = const(f"{name}_b", (rng.normal(size=(1, out_ch, 1, 1))
-                                   * 0.1).astype(np.float32))
-        cur = b.layer("Add", inputs=[(cur[0], cur[1], cur_shape),
-                                     (*bias, (1, out_ch, 1, 1))],
-                      out_shapes=(cur_shape,), name=f"{name}_bias")
-        cur = b.layer("ReLU", inputs=[(cur[0], cur[1], cur_shape)],
-                      out_shapes=(cur_shape,), name=f"{name}_relu")
 
     conv("c1", width, 3, 2)
     conv("c2", width * 2, 3, 2)

@@ -18,7 +18,6 @@ from __future__ import annotations
 from typing import Callable
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from evam_tpu.models.zoo.layers import ConvBlock, SeparableConv
 

@@ -29,7 +29,6 @@ import functools
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:

@@ -38,8 +38,7 @@ def main() -> int:
     from evam_tpu.engine import steps as step_builders
     from evam_tpu.models.registry import ModelRegistry
     from evam_tpu.ops.boxes import decode_boxes
-    from evam_tpu.ops.nms import batched_nms
-    from evam_tpu.ops.preprocess import crop_rois, decode_wire, preprocess_bgr
+    from evam_tpu.ops.preprocess import decode_wire, preprocess_bgr
 
     b, h, w = 32, 1080, 1920
     dev = jax.devices()[0]
