@@ -782,13 +782,21 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
             return jax.image.resize(x, (x.shape[0],) + _out[1:], method=method)
         return interp
     if t in ("Sqrt", "Log", "Abs", "Negative", "Floor", "Ceiling",
-             "Erf", "HSigmoid", "SoftPlus", "Gelu"):
+             "Erf", "HSigmoid", "SoftPlus", "Gelu", "Round", "Sign"):
         return {
             "Sqrt": jnp.sqrt, "Log": jnp.log, "Abs": jnp.abs,
             "Negative": jnp.negative, "Floor": jnp.floor,
             "Ceiling": jnp.ceil, "Erf": jax.scipy.special.erf,
             "HSigmoid": jax.nn.hard_sigmoid, "SoftPlus": jax.nn.softplus,
             "Gelu": jax.nn.gelu,
+            # half_to_even is the spec default; half_away_from_zero
+            # handled below
+            "Round": (
+                jnp.round
+                if a.get("mode", "half_to_even") == "half_to_even"
+                else (lambda x: jnp.trunc(x + jnp.where(x >= 0, 0.5, -0.5)))
+            ),
+            "Sign": jnp.sign,
         }[t]
     if t in ("Greater", "GreaterEqual", "Less", "LessEqual", "Equal",
              "NotEqual", "LogicalAnd", "LogicalOr", "LogicalXor"):
@@ -884,13 +892,15 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
                 x = x.transpose(0, 1, 4, 2, 5, 3)
             return x.reshape(b_, co, h * bs, w * bs)
         return d2s
-    if t in ("ReduceProd", "ReduceL2"):
+    if t in ("ReduceProd", "ReduceL2", "ReduceL1"):
         keep = a.get("keep_dims", "true").lower() in ("1", "true")
 
         def reduce2(x, axes):
             ax = tuple(int(i) for i in np.asarray(axes).reshape(-1))
             if t == "ReduceProd":
                 return jnp.prod(x, axis=ax, keepdims=keep)
+            if t == "ReduceL1":
+                return jnp.sum(jnp.abs(x), axis=ax, keepdims=keep)
             return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keep))
         return reduce2
     if t == "LSTMCell":

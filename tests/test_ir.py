@@ -1087,3 +1087,44 @@ def test_fully_ir_backed_detect_classify(tmp_path):
     probs = out[..., 7:]
     sums = probs.sum(axis=-1)
     assert ((np.abs(sums - 2.0) < 1e-3) | (sums == 0.0)).all()
+
+
+def test_round_sign_reducel1_ops(tmp_path):
+    """Round (half-to-even) → Sign → ReduceL1 chain vs numpy."""
+    b = IRBuilder("mathnet")
+    x = b.layer("Parameter", {"shape": "1,6", "element_type": "f32"},
+                out_shapes=((1, 6),), name="input")
+    rnd = b.layer("Round", inputs=[(x[0], x[1], (1, 6))],
+                  out_shapes=((1, 6),), name="round")
+    sgn = b.layer("Sign", inputs=[(rnd[0], rnd[1], (1, 6))],
+                  out_shapes=((1, 6),), name="sign")
+    axes = b.const(np.asarray([1], np.int64), "axes")
+    l1 = b.layer("ReduceL1", {"keep_dims": "false"},
+                 inputs=[(rnd[0], rnd[1], (1, 6)), (*axes, (1,))],
+                 out_shapes=((1,),), name="l1")
+    b.result((sgn[0], sgn[1], (1, 6)))
+    b.result((l1[0], l1[1], (1,)))
+    model = load_ir(b.write(tmp_path))
+    xin = np.asarray([[0.5, 1.5, -0.4, -2.6, 0.0, 3.2]], np.float32)
+    out = model.forward(model.params, xin)
+    # numpy round is also half-to-even: 0.5→0, 1.5→2
+    rounded = np.round(xin)
+    np.testing.assert_allclose(np.asarray(out["sign"]), np.sign(rounded))
+    np.testing.assert_allclose(np.asarray(out["l1"]),
+                               np.abs(rounded).sum(axis=1))
+
+
+def test_round_half_away_from_zero_mode(tmp_path):
+    """Round's mode attribute: half_away_from_zero vs the half_to_even
+    default differ exactly at .5 boundaries."""
+    b = IRBuilder("roundnet")
+    x = b.layer("Parameter", {"shape": "1,4", "element_type": "f32"},
+                out_shapes=((1, 4),), name="input")
+    r = b.layer("Round", {"mode": "half_away_from_zero"},
+                inputs=[(x[0], x[1], (1, 4))],
+                out_shapes=((1, 4),), name="round")
+    b.result((r[0], r[1], (1, 4)))
+    model = load_ir(b.write(tmp_path))
+    xin = np.asarray([[0.5, 1.5, -0.5, -2.5]], np.float32)
+    out = np.asarray(model.forward(model.params, xin)["round"])
+    np.testing.assert_allclose(out, [[1.0, 2.0, -1.0, -3.0]])
