@@ -184,6 +184,13 @@ def _parse_tensor_iterator(layer_el, layer: IRLayer, blob: bytes) -> dict:
     out_by_port = {p.id: i for i, p in enumerate(layer.outputs)}
     inputs = []
     for el in pm.findall("input"):
+        part_size = _maybe(el, "part_size")
+        if _maybe(el, "axis") is not None and part_size not in (None, 1):
+            raise ValueError(
+                f"TensorIterator {layer.name}: sliced input with "
+                f"part_size={part_size} unsupported (execution takes "
+                f"size-1 slices)"
+            )
         inputs.append({
             "arg": in_by_port[int(el.get("external_port_id"))],
             "layer": int(el.get("internal_layer_id")),
@@ -194,6 +201,12 @@ def _parse_tensor_iterator(layer_el, layer: IRLayer, blob: bytes) -> dict:
         })
     outputs = []
     for el in pm.findall("output"):
+        part_size = _maybe(el, "part_size")
+        if _maybe(el, "axis") is not None and part_size not in (None, 1):
+            raise ValueError(
+                f"TensorIterator {layer.name}: concatenated output with "
+                f"part_size={part_size} unsupported"
+            )
         outputs.append({
             "out": out_by_port[int(el.get("external_port_id"))],
             "layer": int(el.get("internal_layer_id")),
@@ -788,7 +801,16 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
             "Negative": jnp.negative, "Floor": jnp.floor,
             "Ceiling": jnp.ceil, "Erf": jax.scipy.special.erf,
             "HSigmoid": jax.nn.hard_sigmoid, "SoftPlus": jax.nn.softplus,
-            "Gelu": jax.nn.gelu,
+            # OpenVINO Gelu defaults to approximation_mode=ERF; jax's
+            # default is the tanh approximation — pass approximate
+            # explicitly to match
+            "Gelu": (
+                lambda x: jax.nn.gelu(
+                    x,
+                    approximate=a.get("approximation_mode", "ERF").upper()
+                    == "TANH",
+                )
+            ),
             # half_to_even is the spec default; half_away_from_zero
             # handled below
             "Round": (
@@ -1014,8 +1036,23 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
         def tensor_iterator(*inputs):
             # Static trip count (16-frame clips etc.) — the Python
             # loop unrolls into straight-line XLA.
-            m0 = sliced[0]
-            _, trips = _slice_range(m0, inputs[m0["arg"]].shape[m0["axis"]])
+            ranges = {
+                m["layer"]: _slice_range(
+                    m, inputs[m["arg"]].shape[m["axis"]])
+                for m in sliced
+            }
+            all_trips = {lid: t for lid, (_, t) in ranges.items()}
+            trips = next(iter(all_trips.values()))
+            if len(set(all_trips.values())) > 1:
+                raise ValueError(
+                    f"TensorIterator {layer.name}: sliced inputs disagree "
+                    f"on trip count: {all_trips}"
+                )
+            if trips <= 0:
+                raise ValueError(
+                    f"TensorIterator {layer.name}: zero-trip slice range "
+                    "(empty time axis?) — refusing to emit empty outputs"
+                )
 
             state: dict[int, Any] = {}
             for m in ti_inputs:
@@ -1030,8 +1067,7 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
                 for m in ti_inputs:
                     if m["axis"] is None:
                         continue
-                    begin, _ = _slice_range(
-                        m, inputs[m["arg"]].shape[m["axis"]])
+                    begin, _ = ranges[m["layer"]]
                     bindings[m["layer"]] = lax.index_in_dim(
                         inputs[m["arg"]], begin + it * m["stride"],
                         axis=m["axis"], keepdims=True,

@@ -55,7 +55,13 @@ def resize_planes(
     """Bilinear-resize a stack of planes [..., H, W] → [..., th, tw].
 
     Two einsum contractions (rows, then columns) in ``compute_dtype``
-    with float32 accumulation; returns float32.
+    with float32 accumulation; returns float32. The intermediate is
+    cast back to ``compute_dtype`` between the contractions so both
+    ride the MXU's bf16 path — that round-trip costs ~1 LSB of u8
+    luma vs jax.image.resize's all-f32 result (tests/test_ops.py pins
+    atol < 2.0 on a 0-255 scale). Pass ``compute_dtype=jnp.float32``
+    for near-exact parity (f32 matmul vs compiled gather/scatter
+    rounding only).
     """
     th, tw = out_hw
     h, w = x.shape[-2], x.shape[-1]

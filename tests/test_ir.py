@@ -799,6 +799,112 @@ def test_tensor_iterator_reverse_slice(tmp_path):
     np.testing.assert_allclose(got, xin[:, ::-1])
 
 
+def _identity_ti_ir(tmp_path, t, d, input_map_attrs):
+    """Identity-body TensorIterator with caller-chosen <input> port-map
+    attrs (the fail-loud guard tests drive part_size / degenerate
+    ranges through here)."""
+    body = IRBuilder("gbody")
+    bx = body.layer("Parameter", {"shape": f"1,1,{d}", "element_type": "f32"},
+                    out_shapes=((1, 1, d),), name="xt")
+    r_x = body.result((bx[0], bx[1], (1, 1, d)))
+    body_xml = (f'<layers>{"".join(body.layers)}</layers>'
+                f'<edges>{"".join(body.edges)}</edges>')
+    b = IRBuilder("guard_ti")
+    b._next_id = 100
+    x = b.layer("Parameter", {"shape": f"1,{t},{d}", "element_type": "f32"},
+                out_shapes=((1, t, d),), name="input")
+    ti_id = b._next_id
+    b._next_id += 1
+    attrs = " ".join(f'{k}="{v}"' for k, v in input_map_attrs.items())
+    b.layers.append(
+        f'<layer id="{ti_id}" name="ti" type="TensorIterator" version="opset1">'
+        '<input>'
+        f'<port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        '</input><output>'
+        f'<port id="1"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        '</output>'
+        '<port_map>'
+        f'<input external_port_id="0" internal_layer_id="{bx[0]}" {attrs}/>'
+        f'<output external_port_id="1" internal_layer_id="{r_x[0]}" axis="1"/>'
+        '</port_map>'
+        f'<body>{body_xml}</body>'
+        '</layer>'
+    )
+    b.edges.append(
+        f'<edge from-layer="{x[0]}" from-port="{x[1]}" '
+        f'to-layer="{ti_id}" to-port="0"/>'
+    )
+    b.layers.append(
+        '<layer id="200" name="res" type="Result" version="opset1">'
+        f'<input><port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim>'
+        '</port></input></layer>'
+    )
+    b.edges.append(
+        f'<edge from-layer="{ti_id}" from-port="1" to-layer="200" to-port="0"/>'
+    )
+    return b.write(tmp_path)
+
+
+def test_tensor_iterator_guards(tmp_path):
+    """The importer fails loud on TI shapes it can't execute:
+    part_size>1 slicing (execution assumes size-1 slices) and a
+    zero-trip slice range (start == end)."""
+    import pytest
+
+    xml = _identity_ti_ir(tmp_path, 4, 3,
+                          {"axis": 1, "part_size": 2})
+    with pytest.raises(ValueError, match="part_size=2"):
+        load_ir(xml)
+
+    (tmp_path / "zt").mkdir()
+    xml = _identity_ti_ir(tmp_path / "zt", 4, 3,
+                          {"axis": 1, "start": 2, "end": 2})
+    model = load_ir(xml)
+    with pytest.raises(ValueError, match="zero-trip"):
+        model.forward(model.params,
+                      np.zeros((1, 4, 3), np.float32))
+
+    # part_size=1 (explicit) stays accepted — the guard must not
+    # reject the value every real OMZ decoder uses
+    (tmp_path / "ok").mkdir()
+    xml = _identity_ti_ir(tmp_path / "ok", 4, 3,
+                          {"axis": 1, "part_size": 1})
+    model = load_ir(xml)
+    xin = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    np.testing.assert_allclose(
+        np.asarray(model.forward(model.params, xin)["ti"]), xin)
+
+
+def test_gelu_default_erf_mode(tmp_path):
+    """OpenVINO Gelu defaults to approximation_mode=ERF — the importer
+    must not fall back to jax.nn.gelu's tanh default (ADVICE r2). The
+    tanh mode is honored when the IR asks for it (case-insensitive)."""
+    from scipy.special import erf as _erf
+
+    def build(attrs, sub):
+        (tmp_path / sub).mkdir(exist_ok=True)
+        b = IRBuilder("gelu_net")
+        p = b.layer("Parameter", {"shape": "1,8", "element_type": "f32"},
+                    out_shapes=[(1, 8)])
+        g = b.layer("Gelu", attrs, inputs=[(p[0], p[1], (1, 8))],
+                    out_shapes=[(1, 8)])
+        b.result((g[0], g[1], (1, 8)))
+        return load_ir(b.write(tmp_path / sub))
+
+    x = np.linspace(-4, 4, 8, dtype=np.float32).reshape(1, 8)
+    m_def = build({}, "d")
+    y_def = np.asarray(m_def.forward(m_def.params, x)["gelu_1"])
+    ref_erf = x * 0.5 * (1 + _erf(x / np.sqrt(2)))
+    np.testing.assert_allclose(y_def, ref_erf, atol=1e-5)
+
+    m_tanh = build({"approximation_mode": "tanh"}, "t")
+    y_tanh = np.asarray(m_tanh.forward(m_tanh.params, x)["gelu_1"])
+    ref_tanh = 0.5 * x * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+    np.testing.assert_allclose(y_tanh, ref_tanh, atol=1e-3)
+    assert np.abs(y_def - y_tanh).max() > 1e-5  # modes genuinely differ
+
+
 def test_omz_shaped_ssd_vs_torch(tmp_path):
     """Full crossroad-0078-shaped topology (MobileNet-v1 depthwise
     ladder, 2-scale SSD heads, Transpose/Reshape/Concat wiring,

@@ -263,6 +263,10 @@ def test_separable_resize_matches_jax_image():
     gotp = resize_planes(p, (40, 56))
     assert np.abs(np.asarray(gotp) - np.asarray(refp)).max() < 2.0
 
+    # f32 compute mode: near-exact parity (same weights, f32 matmul)
+    gotp32 = resize_planes(p, (40, 56), compute_dtype=jnp.float32)
+    assert np.abs(np.asarray(gotp32) - np.asarray(refp)).max() < 1e-3
+
     # the numpy weight matrix IS jax.image.resize's per-axis operator
     # (resizing an identity matrix along axis 0 yields exactly it)
     from evam_tpu.ops.resize import resize_matrix
