@@ -41,6 +41,7 @@ class EngineHub:
         wire_format: str = "i420",
         warmup: bool = False,
         stall_timeout_s: float = 120.0,
+        device_synth: bool = False,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -53,7 +54,16 @@ class EngineHub:
         #: host→device frame encoding for video engines ("i420" halves
         #: ingest bandwidth; see evam_tpu.ops.color)
         self.wire_format = wire_format
+        #: bench-only mode (bench.py --config serve --serve-ingest
+        #: seed): video stages submit uint32 seeds and each engine's
+        #: step synthesizes its wire batch on-chip
+        #: (steps.wrap_device_synth) — the serving path minus only the
+        #: host→device pixel copy
+        self.device_synth = device_synth
         self._engines: dict[str, BatchEngine] = {}
+        #: device_synth only: engine key → the (H, W) its on-chip
+        #: generator was compiled for (cache-hit mismatch guard)
+        self._synth_hw: dict[str, tuple[int, int] | None] = {}
         self._models: dict[str, LoadedModel] = {}
         # RLock: engine() calls model() while holding the lock.
         self._lock = threading.RLock()
@@ -78,6 +88,7 @@ class EngineHub:
         """
         if kind not in _BUILDERS:
             raise ValueError(f"no step builder for stage kind '{kind}'")
+        synth_hw = builder_kwargs.pop("synth_wire_hw", None)
         key = f"{kind}:{instance_id or model_key}"
         with self._lock:
             if key not in self._engines:
@@ -86,6 +97,9 @@ class EngineHub:
                 if wired:
                     builder_kwargs.setdefault("wire_format", self.wire_format)
                 step_fn = builder(model, **builder_kwargs)
+                if self.device_synth and wired:
+                    step_fn = self._synth_wrap(step_fn, synth_hw, key)
+                    self._synth_hw[key] = tuple(synth_hw)
                 self._engines[key] = BatchEngine(
                     name=key,
                     step_fn=step_fn,
@@ -97,6 +111,8 @@ class EngineHub:
                     stall_timeout_s=self.stall_timeout_s,
                 )
                 log.info("created engine %s (model %s)", key, model_key)
+            elif self.device_synth and synth_hw is not None:
+                self._check_synth_hw(key, synth_hw)
             return self._engines[key]
 
     def fused_engine(
@@ -111,6 +127,7 @@ class EngineHub:
         (e.g. the object-class filter) are part of the cache key —
         pipelines may only share a fused program when the compiled
         semantics match."""
+        synth_hw = builder_kwargs.pop("synth_wire_hw", None)
         kw_sig = ",".join(f"{k}={v}" for k, v in sorted(builder_kwargs.items()))
         key = f"detect_classify:{instance_id or det_key + '+' + cls_key}:{kw_sig}"
         with self._lock:
@@ -121,6 +138,9 @@ class EngineHub:
                 step_fn = step_builders.build_detect_classify_step(
                     det, cls, **builder_kwargs
                 )
+                if self.device_synth:
+                    step_fn = self._synth_wrap(step_fn, synth_hw, key)
+                    self._synth_hw[key] = tuple(synth_hw)
                 self._engines[key] = BatchEngine(
                     name=key,
                     step_fn=step_fn,
@@ -132,7 +152,38 @@ class EngineHub:
                     stall_timeout_s=self.stall_timeout_s,
                 )
                 log.info("created fused engine %s", key)
+            elif self.device_synth and synth_hw is not None:
+                self._check_synth_hw(key, synth_hw)
             return self._engines[key]
+
+    def _check_synth_hw(self, key: str, synth_hw) -> None:
+        """Device-synth cache hits must agree on the wire resolution —
+        seeds carry no shape, so unlike the host pixel path nothing
+        downstream would catch a mismatch (it would silently measure
+        the wrong wire size)."""
+        have = self._synth_hw.get(key)
+        if have is not None and tuple(synth_hw) != have:
+            raise ValueError(
+                f"engine {key}: device_synth compiled for wire {have} "
+                f"but a stage requested {tuple(synth_hw)} — give the "
+                "stages matching ingest sizes or distinct "
+                "model-instance-ids"
+            )
+
+    def _synth_wrap(self, step_fn, synth_hw: tuple[int, int] | None, key: str):
+        """Wrap a wire-frame step for device_synth mode (the stage must
+        pass its ingest (H, W) as ``synth_wire_hw`` so the on-chip
+        generator produces wire batches of the exact serving shape)."""
+        if synth_hw is None:
+            raise ValueError(
+                f"engine {key}: EngineHub(device_synth=True) requires the "
+                "stage to pass synth_wire_hw=(H, W)"
+            )
+        from evam_tpu.ops.color import wire_shape
+
+        h, w = synth_hw
+        return step_builders.wrap_device_synth(
+            step_fn, wire_shape(self.wire_format, h, w))
 
     def stats(self) -> dict[str, dict]:
         with self._lock:

@@ -39,6 +39,51 @@ from evam_tpu.ops.preprocess import (
 DETECT_FIELDS = 7
 
 
+def weyl_bits(seeds, n: int) -> jnp.ndarray:
+    """[...]-shaped uint32 seeds → [..., n] uint32 Weyl-sequence bits.
+
+    THE on-chip synthetic-data generator: bench.py --ingest device,
+    the serve bench's device-synth mode (wrap_device_synth), the
+    action-decoder mini-measure and tools/profile_budget.py all draw
+    from this one recipe, so "same generator as the headline bench"
+    stays true by construction. Plain iota arithmetic, not the PRNG —
+    smallest possible op surface on experimental backends.
+    """
+    i = jax.lax.iota(jnp.uint32, n)
+    return i * jnp.uint32(2654435761) + jnp.asarray(
+        seeds, jnp.uint32)[..., None]
+
+
+def wrap_device_synth(step_fn, wire_shape: tuple[int, ...]) -> Callable:
+    """Device-synth serving ingest: per-item uint32 seeds replace wire
+    frames, and the uint8 wire batch is synthesized ON-CHIP (the same
+    Weyl-sequence generator as ``bench.py --ingest device``) before the
+    wrapped step runs.
+
+    Used by ``EngineHub(device_synth=True)`` so ``bench.py --config
+    serve`` can measure the REAL serving path — source →
+    StreamRunner → BatchEngine dispatcher/completer → tracker →
+    metaconvert → publish — without the per-frame host→device pixel
+    copy, which in this environment rides a ~18 MB/s tunnel and would
+    measure the link rather than the framework (PROFILE.md "ingest").
+    Every other byte of the serving path (threads, queues, deadline
+    batching, bucket padding, readback, host postprocess) is exercised
+    unchanged; only ``frames`` arrives as a [B] seed vector.
+    """
+    import numpy as np
+
+    n = int(np.prod(wire_shape))
+
+    def synth_step(params, seeds, *rest):
+        b = seeds.shape[0]
+        mix = seeds.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        frames = (weyl_bits(mix, n) >> jnp.uint32(13)).astype(jnp.uint8)
+        return step_fn(params, frames.reshape((b,) + tuple(wire_shape)),
+                       *rest)
+
+    return synth_step
+
+
 def _head_probs(model, name: str, out) -> jnp.ndarray:
     """Per-head probabilities, honoring in-graph SoftMax of IR imports."""
     x = out[name].astype(jnp.float32)

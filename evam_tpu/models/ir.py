@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import xml.etree.ElementTree as ET
 from pathlib import Path
 from typing import Any, Callable
@@ -762,9 +763,10 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
             )
 
         def gather(x, idx, axis=np.int64(0)):
+            # mo emits the axis input both 0-d and shape-(1,)
             return jnp.take(
                 x, jnp.asarray(idx).astype(jnp.int32),
-                axis=int(np.asarray(axis)),
+                axis=int(np.asarray(axis).reshape(-1)[0]),
             )
         return gather
     if t == "Pad":
@@ -1109,6 +1111,123 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
     )
 
 
+# --------------------------------------------------------------------------
+# NHWC layout pass (import-time; round-2 VERDICT item 4)
+#
+# IR graphs are NCHW; on TPU the NCHW convs measured ~33% slower than
+# the NHWC zoo nets (tools/profile_ir_layout.py, PROFILE.md). Rather
+# than rewrite the graph, the execution plan tracks a layout tag per
+# value: convolutions/pools run with NHWC dimension numbers, layout-
+# neutral elementwise ops propagate NHWC, broadcastable constants are
+# re-mapped at trace time, and everything layout-sensitive (Reshape,
+# Transpose, Concat, head wiring, shape machinery) receives NCHW via
+# cached transposes. XLA cancels the adjacent transpose pairs this
+# leaves at region boundaries.
+# --------------------------------------------------------------------------
+
+#: elementwise ops that ignore data layout entirely (unary, no
+#: shape-coupled attrs)
+_LAYOUT_NEUTRAL = {
+    "ReLU", "Sigmoid", "Tanh", "Exp", "Abs", "Clamp", "Elu", "HSwish",
+    "Swish", "Mish", "Sqrt", "Log", "Negative", "Floor", "Ceiling",
+    "Erf", "HSigmoid", "SoftPlus", "Gelu", "Round", "Sign", "Convert",
+    "LogicalNot",
+}
+
+#: binary/n-ary elementwise ops whose non-tensor inputs are broadcast
+#: constants that can be re-mapped to NHWC
+_LAYOUT_ELTWISE = {
+    "Add", "Multiply", "Subtract", "Divide", "Power", "Maximum",
+    "Minimum", "PReLU", "FakeQuantize",
+}
+
+
+def _const_nhwc_map(shape: tuple[int, ...]):
+    """How to re-map an NCHW-broadcast constant of ``shape`` for NHWC
+    data: a (transpose_perm, reshape) recipe, or None when no safe
+    mapping exists (e.g. a (C,) vector, which NCHW-aligns to W but
+    NHWC-aligns to C — passing it through would silently change
+    semantics)."""
+    nd = len(shape)
+    numel = int(np.prod(shape)) if shape else 1
+    if numel == 1:
+        return ("flat", ())  # broadcast-all: layout-independent
+    if nd == 4:
+        return ("perm", (0, 2, 3, 1))
+    if nd == 3 and shape[1] == 1 and shape[2] == 1:
+        # (C,1,1) channel column → (1,1,C)
+        return ("reshape", (1, 1, shape[0]))
+    return None
+
+
+def _apply_const_map(v, recipe):
+    import jax.numpy as jnp
+
+    kind, arg = recipe
+    if kind == "flat":
+        return jnp.asarray(v).reshape(())
+    if kind == "perm":
+        return jnp.transpose(jnp.asarray(v), arg)
+    return jnp.asarray(v).reshape(arg)
+
+
+def _nhwc_conv_op(layer: IRLayer) -> Callable:
+    """Convolution/GroupConvolution with NHWC activations (weights stay
+    OIHW — XLA's layout assignment relayouts them once)."""
+    from jax import lax
+
+    a = layer.attrs
+    grouped = layer.type == "GroupConvolution"
+
+    def conv(x, w):
+        if grouped:
+            g = w.shape[0]
+            w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])
+        else:
+            g = 1
+        strides = _pair(a, "strides", "1,1")
+        dils = _pair(a, "dilations", "1,1")
+        return lax.conv_general_dilated(
+            x, w.astype(x.dtype),
+            window_strides=strides,
+            padding=_conv_padding(
+                a, 2, tuple(x.shape[1:3]), tuple(w.shape[2:]),
+                dils, strides,
+            ),
+            rhs_dilation=dils,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+            feature_group_count=g,
+        )
+    return conv
+
+
+def _nhwc_pool_op(layer: IRLayer) -> Callable:
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = layer.attrs
+    is_max = layer.type == "MaxPool"
+
+    def pool(x):
+        k = _pair(a, "kernel")
+        s = _pair(a, "strides", ",".join(["1"] * len(k)))
+        pad = _window_padding(a, x.shape[1:3], k, s)
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + pad + [(0, 0)]
+        if is_max:
+            return lax.reduce_window(
+                x, -jnp.inf, lax.max, window, strides, pads)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add, window, strides, pads)
+        if a.get("exclude-pad", "true").lower() in ("1", "true"):
+            counts = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+    return pool
+
+
 def _window_padding(attrs, spatial, kernel, strides):
     auto = attrs.get("auto_pad", "explicit")
     if auto in ("same_upper", "same_lower"):
@@ -1178,12 +1297,17 @@ def _sanitize(name: str) -> str:
     return name.replace("/", "_").replace(".", "_")
 
 
-def build_ir_model(graph: IRGraph) -> ImportedIRModel:
+def build_ir_model(
+    graph: IRGraph, layout: str | None = None
+) -> ImportedIRModel:
     """Constant-fold, cut at DetectionOutput if present, and compile
     the remaining layers into a pure jax ``forward(params, x)``.
 
     ``x`` is NCHW float (the IR convention); the registry wraps the
-    NHWC→NCHW transpose for the engine's NHWC frames.
+    NHWC→NCHW transpose for the engine's NHWC frames. ``layout``
+    ("nhwc" default, "nchw" to disable — env ``EVAM_IR_LAYOUT``)
+    selects the internal execution layout for conv regions (the NHWC
+    pass, see _nhwc_conv_op); numerics are identical either way.
     """
     constant_fold(graph)
 
@@ -1336,39 +1460,130 @@ def build_ir_model(graph: IRGraph) -> ImportedIRModel:
     for _, lid, _pid in wanted:
         mark(lid)
 
-    plan: list[tuple[IRLayer, Callable, list[tuple[int, int]]]] = []
+    # ---- layout-aware plan (NHWC pass; see the section header above
+    # _nhwc_conv_op). Each entry: (layer, op, srcs, wants, out_layout)
+    # where wants[i] is "nchw" / "nhwc" / "raw" / ("cmap", recipe).
+    if layout is None:
+        layout = os.environ.get("EVAM_IR_LAYOUT", "nhwc")
+    use_nhwc = layout == "nhwc" and any(
+        l.type in ("Convolution", "GroupConvolution")
+        for l in graph.layers.values() if l.id in needed
+    )
+
+    def _port_rank(layer: IRLayer, idx: int) -> int:
+        return len(layer.inputs[idx].shape) if idx < len(layer.inputs) else 0
+
+    val_layout: dict[tuple[int, int], str] = {
+        (input_layer.id, input_layer.outputs[0].id): "nchw"
+    }
+    plan: list[tuple] = []
     for layer in order:
         if layer.id not in needed or layer.type in ("Parameter", "Const", "Result"):
             continue
-        op = _jax_op(layer)
         srcs = [graph.edges[(layer.id, p.id)] for p in layer.inputs]
-        plan.append((layer, op, srcs))
+        is_const = [s[0] in graph.consts for s in srcs]
+        t = layer.type
+        op = None
+        wants: list = ["nchw"] * len(srcs)
+        out_layout = "nchw"
+        if use_nhwc:
+            if (
+                t in ("Convolution", "GroupConvolution")
+                and len(srcs) == 2 and not is_const[0] and is_const[1]
+                and _port_rank(layer, 0) == 4
+                and len(layer.outputs[0].shape) == 4
+            ):
+                op = _nhwc_conv_op(layer)
+                wants = ["nhwc", "raw"]
+                out_layout = "nhwc"
+            elif (
+                t in ("MaxPool", "AvgPool")
+                and not is_const[0]
+                and _port_rank(layer, 0) == 4
+            ):
+                op = _nhwc_pool_op(layer)
+                wants = ["nhwc"]
+                out_layout = "nhwc"
+            elif (
+                t in _LAYOUT_NEUTRAL
+                and len(srcs) == 1 and not is_const[0]
+            ):
+                have = val_layout.get(srcs[0], "nchw")
+                wants = [have]
+                out_layout = have
+            elif t in _LAYOUT_ELTWISE and any(
+                not c and val_layout.get(s, "nchw") == "nhwc"
+                for s, c in zip(srcs, is_const)
+            ) and all(
+                # every runtime input must be rank-4 to transpose; a
+                # lower-rank tensor NCHW-broadcasts differently (e.g.
+                # a rank-1 value aligns to W in NCHW but C in NHWC)
+                c or _port_rank(layer, i) == 4
+                for i, c in enumerate(is_const)
+            ):
+                recipes = []
+                ok = True
+                for s, c in zip(srcs, is_const):
+                    if not c:
+                        recipes.append("nhwc")
+                        continue
+                    cval = static.get(s[0], graph.consts[s[0]])
+                    r = _const_nhwc_map(tuple(cval.shape))
+                    if r is None:
+                        ok = False
+                        break
+                    recipes.append(("cmap", r))
+                if ok:
+                    wants = recipes
+                    out_layout = "nhwc"
+        if op is None:
+            op = _jax_op(layer)
+        for port in layer.outputs:
+            val_layout[(layer.id, port.id)] = out_layout
+        plan.append((layer, op, srcs, wants, out_layout))
 
     layer_names = {lid: _sanitize(graph.layers[lid].name) for lid in graph.consts}
 
     def forward(p: dict, x):
-        values: dict[tuple[int, int], Any] = {
-            (input_layer.id, input_layer.outputs[0].id): x
-        }
+        import jax.numpy as jnp
 
-        def resolve(src: tuple[int, int]):
+        values: dict[tuple[int, int], tuple[Any, str]] = {
+            (input_layer.id, input_layer.outputs[0].id): (x, "nchw")
+        }
+        relayout_cache: dict[tuple, Any] = {}
+
+        def resolve_const(src: tuple[int, int]):
+            nm = layer_names[src[0]]
+            return p[nm] if nm in p else static.get(src[0], graph.consts[src[0]])
+
+        def fetch(src: tuple[int, int], want):
             if src in values:
-                return values[src]
-            lid = src[0]
-            if lid in graph.consts:
-                nm = layer_names[lid]
-                return p[nm] if nm in p else static.get(lid, graph.consts[lid])
+                arr, have = values[src]
+                if want in ("raw", have):
+                    return arr
+                key = (src, want)
+                if key not in relayout_cache:
+                    perm = (0, 2, 3, 1) if want == "nhwc" else (0, 3, 1, 2)
+                    relayout_cache[key] = jnp.transpose(arr, perm)
+                return relayout_cache[key]
+            if src[0] in graph.consts:
+                arr = resolve_const(src)
+                if isinstance(want, tuple):  # ("cmap", recipe)
+                    return _apply_const_map(arr, want[1])
+                return arr
             raise KeyError(f"unresolved IR edge {src}")
 
-        for layer, op, srcs in plan:
-            ins = [resolve(s) for s in srcs]
+        for layer, op, srcs, wants, out_layout in plan:
+            ins = [fetch(s, w) for s, w in zip(srcs, wants)]
             out = op(*ins)
             if isinstance(out, tuple):
                 for port, o in zip(layer.outputs, out):
-                    values[(layer.id, port.id)] = o
+                    values[(layer.id, port.id)] = (o, out_layout)
             else:
-                values[(layer.id, layer.outputs[0].id)] = out
-        return {name: values[(lid, pid)] for name, lid, pid in wanted}
+                values[(layer.id, layer.outputs[0].id)] = (out, out_layout)
+        return {
+            name: fetch((lid, pid), "nchw") for name, lid, pid in wanted
+        }
 
     return ImportedIRModel(
         name=graph.name,

@@ -38,6 +38,17 @@ def bgr_to_i420_host(frame: np.ndarray) -> np.ndarray:
     return cv2.cvtColor(frame, cv2.COLOR_BGR2YUV_I420)
 
 
+def wire_shape(wire_format: str, height: int, width: int) -> tuple[int, ...]:
+    """Per-frame host/device array shape for a wire format — the ONE
+    place the format→shape rule lives (engine warmup, device-synth
+    wrapper, and bench all derive from it)."""
+    if wire_format == "i420":
+        return i420_shape(height, width)
+    if wire_format == "bgr":
+        return (height, width, 3)
+    raise ValueError(f"unknown wire format {wire_format!r}")
+
+
 def i420_shape(height: int, width: int) -> tuple[int, int]:
     # The planar wire layout packs the h/2 x w/2 U and V planes as
     # h/4 full-width rows each, so height must divide by 4 (i420_to_bgr

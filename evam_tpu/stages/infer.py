@@ -14,6 +14,7 @@ parameters (the engine's in-jit NMS uses a permissive floor).
 from __future__ import annotations
 
 import copy
+import itertools
 from collections import deque
 from concurrent.futures import Future
 
@@ -60,6 +61,11 @@ def _encode_wire(frame_bgr: np.ndarray, wire_format: str) -> np.ndarray:
     return np.ascontiguousarray(frame_bgr)
 
 
+#: per-process frame-seed sequence for device_synth mode (the GIL makes
+#: itertools.count().__next__ atomic enough for distinct seeds)
+_SYNTH_SEQ = itertools.count()
+
+
 def _wire_frame(
     frame: np.ndarray, size: tuple[int, int], wire_format: str
 ) -> np.ndarray:
@@ -67,7 +73,13 @@ def _wire_frame(
     native kernel (native/evam_media.cpp) instead of a resize pass
     plus a convert pass; this is the per-frame host hot op at high
     stream counts. native.resize_bgr_to_i420 owns the
-    native-vs-cv2 policy and fallback."""
+    native-vs-cv2 policy and fallback.
+
+    ``wire_format="seed"`` (EngineHub.device_synth, bench.py --config
+    serve): the engine synthesizes pixels on-chip, so the stage
+    submits only a distinct uint32 per frame."""
+    if wire_format == "seed":
+        return np.uint32(next(_SYNTH_SEQ) & 0xFFFFFFFF)
     if wire_format == "i420":
         from evam_tpu import native
 
@@ -83,12 +95,12 @@ def _warm_engine(hub: EngineHub, engine, ingest_size, wire_format,
     if not hub.warmup:
         return
     h, w = ingest_size
-    if wire_format == "i420":
-        from evam_tpu.ops.color import i420_shape
-
-        frame = np.zeros(i420_shape(h, w), np.uint8)
+    if wire_format == "seed":
+        frame = np.uint32(0)
     else:
-        frame = np.zeros((h, w, 3), np.uint8)
+        from evam_tpu.ops.color import wire_shape
+
+        frame = np.zeros(wire_shape(wire_format, h, w), np.uint8)
     engine.warm_async(frames=frame, **extra_example)
 
 
@@ -108,16 +120,17 @@ class DetectStage(AsyncStage):
                 name, self.threshold, ENGINE_SCORE_FLOOR, ENGINE_SCORE_FLOOR,
             )
         self.interval = max(1, int(properties.get("inference-interval", 1)))
+        self.model = hub.model(model_key)
+        self.wire = "seed" if hub.device_synth else hub.wire_format
+        self.ingest_size = _wire_safe_size(
+            (self.model.preprocess.height, self.model.preprocess.width)
+        )
         self.engine = hub.engine(
             "detect",
             model_key,
             properties.get("model-instance-id"),
             score_threshold=ENGINE_SCORE_FLOOR,
-        )
-        self.model = hub.model(model_key)
-        self.wire = hub.wire_format
-        self.ingest_size = _wire_safe_size(
-            (self.model.preprocess.height, self.model.preprocess.width)
+            synth_wire_hw=self.ingest_size,
         )
         _warm_engine(hub, self.engine, self.ingest_size, self.wire)
         self._count = 0
@@ -176,19 +189,20 @@ class ClassifyStage(AsyncStage):
         self.object_class = properties.get("object-class")
         self.interval = max(1, int(properties.get("reclassify-interval", 1)))
         self.threshold = float(properties.get("threshold", 0.0))
-        self.wire = hub.wire_format
-        self.engine = hub.engine(
-            "classify",
-            model_key,
-            properties.get("model-instance-id"),
-            roi_budget=self.ROI_BUDGET,
-        )
+        self.wire = "seed" if hub.device_synth else hub.wire_format
         self.model = hub.model(model_key)
         # Crops are taken on-device from the submitted frame; a fixed
         # canonical ingest resolution keeps cross-stream batches
         # stackable while preserving enough pixels for small ROIs.
         self.ingest_size = _wire_safe_size(
             tuple(properties.get("ingest-size", (432, 768)))
+        )
+        self.engine = hub.engine(
+            "classify",
+            model_key,
+            properties.get("model-instance-id"),
+            roi_budget=self.ROI_BUDGET,
+            synth_wire_hw=self.ingest_size,
         )
         _warm_engine(
             hub, self.engine, self.ingest_size, self.wire,
@@ -254,18 +268,19 @@ class ActionStage(AsyncStage):
         self.name = name
         enc_key = properties.get("enc-model", "action_recognition/encoder")
         dec_key = properties.get("dec-model", "action_recognition/decoder")
-        self.enc_engine = hub.engine("action_encode", enc_key,
-                                     properties.get("model-instance-id"))
-        self.dec_engine = hub.engine("action_decode", dec_key)
         self.dec_model = hub.model(dec_key)
         self.enc_model = hub.model(enc_key)
         self.ingest_size = _wire_safe_size((
             self.enc_model.preprocess.height,
             self.enc_model.preprocess.width,
         ))
+        self.enc_engine = hub.engine("action_encode", enc_key,
+                                     properties.get("model-instance-id"),
+                                     synth_wire_hw=self.ingest_size)
+        self.dec_engine = hub.engine("action_decode", dec_key)
         self.clip: deque[np.ndarray] = deque(maxlen=CLIP_LEN)
         self.threshold = float(properties.get("threshold", 0.0))
-        self.wire = hub.wire_format
+        self.wire = "seed" if hub.device_synth else hub.wire_format
         _warm_engine(hub, self.enc_engine, self.ingest_size, self.wire)
         if hub.warmup:
             embed_dim = getattr(self.enc_model.module, "embed_dim", 512)
@@ -430,6 +445,10 @@ class FusedDetectClassifyStage(AsyncStage):
                 i for i, lbl in enumerate(self.det_model.labels)
                 if lbl == self.object_class
             )
+        self.wire = "seed" if hub.device_synth else hub.wire_format
+        self.ingest_size = _wire_safe_size(
+            (self.det_model.preprocess.height, self.det_model.preprocess.width)
+        )
         self.engine = hub.fused_engine(
             det_key,
             cls_key,
@@ -437,12 +456,9 @@ class FusedDetectClassifyStage(AsyncStage):
             roi_budget=self.ROI_BUDGET,
             score_threshold=ENGINE_SCORE_FLOOR,
             allowed_label_ids=allowed,
+            synth_wire_hw=self.ingest_size,
         )
         self.cls_model = hub.model(cls_key)
-        self.wire = hub.wire_format
-        self.ingest_size = _wire_safe_size(
-            (self.det_model.preprocess.height, self.det_model.preprocess.width)
-        )
         _warm_engine(hub, self.engine, self.ingest_size, self.wire)
         self._count = 0
         self._last_regions: list[Region] = []

@@ -41,7 +41,9 @@ def test_bench_healthy_cpu_run_emits_contract_line():
     )
     assert r.returncode == 0, r.stderr[-1500:]
     data = _assert_contract(r)
-    assert data["metric"] == "audio_streams_30fps_per_chip"
+    # audio streams normalize by window rate (5/s at the reference's
+    # 0.2 s sliding-window stride), not 30 fps — bench._metric_for
+    assert data["metric"] == "audio_streams_per_chip"
     assert data["value"] > 0
     assert {"batch", "depth", "p50_ms", "p99_ms"} <= set(data)
 

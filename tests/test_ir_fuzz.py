@@ -1,0 +1,608 @@
+"""Property-based IR round-trip fuzz (round-2 VERDICT item 3c/3d).
+
+The importer had only ever parsed XML that its own ``ir_build`` emits.
+Here random graphs are generated op-by-op with an INDEPENDENT numpy
+evaluation carried alongside, written through IRBuilder, then
+"mo-ified" — the XML is post-processed with artifacts Intel's Model
+Optimizer produces that the in-repo writer never does (mixed opset
+version tags, <rt_info> blocks in layers and net, <meta_data>,
+precision attributes on ports, omitted default attributes) — and
+finally parsed + executed by models/ir.py. Output must match the
+numpy reference.
+
+Reference for the artifact list: IR v10/v11 files produced by
+openvino.tools.mo (reference tools/model_downloader/downloader.py
+converts OMZ models through it).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from evam_tpu.models.ir import load_ir
+from evam_tpu.models.ir_build import IRBuilder
+
+
+# ------------------------------------------------------------------ numpy ops
+
+
+def _np_conv(x, w, strides, pads_begin, pads_end, groups=1):
+    """Direct NCHW convolution (tiny shapes only)."""
+    n, c, h, wd = x.shape
+    if groups == 1:
+        o, ci, kh, kw = w.shape
+        wg = w.reshape(1, o, ci, kh, kw)
+    else:
+        g, og, ci, kh, kw = w.shape
+        o = g * og
+        wg = w
+    g = groups if groups > 1 else 1
+    sh, sw = strides
+    xp = np.pad(x, ((0, 0), (0, 0),
+                    (pads_begin[0], pads_end[0]),
+                    (pads_begin[1], pads_end[1])))
+    hp, wp = xp.shape[2:]
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    out = np.zeros((n, o, oh, ow), np.float32)
+    cg = c // g
+    og_ = o // g
+    for gi in range(g):
+        xs = xp[:, gi * cg:(gi + 1) * cg]
+        ws = wg[gi] if groups > 1 else wg[0]
+        for oi in range(og_):
+            for yy in range(oh):
+                for xx in range(ow):
+                    patch = xs[:, :, yy * sh:yy * sh + kh,
+                               xx * sw:xx * sw + kw]
+                    out[:, gi * og_ + oi, yy, xx] = (
+                        patch * ws[oi]).sum(axis=(1, 2, 3))
+    return out
+
+
+def _same_upper_pads(h, w, kh, kw, sh, sw):
+    oh, ow = -(-h // sh), -(-w // sw)
+    ph = max((oh - 1) * sh + kh - h, 0)
+    pw = max((ow - 1) * sw + kw - w, 0)
+    return (ph // 2, pw // 2), (ph - ph // 2, pw - pw // 2)
+
+
+def _np_pool(x, k, s, op):
+    n, c, h, w = x.shape
+    oh = (h - k) // s + 1
+    ow = (w - k) // s + 1
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for yy in range(oh):
+        for xx in range(ow):
+            patch = x[:, :, yy * s:yy * s + k, xx * s:xx * s + k]
+            out[:, :, yy, xx] = (
+                patch.max(axis=(2, 3)) if op == "max"
+                else patch.mean(axis=(2, 3))
+            )
+    return out
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ------------------------------------------------------------ graph generator
+
+
+class FuzzGraph:
+    """Random op chain over a [1,C,H,W] tensor with a parallel numpy
+    reference; every op emits the IR layer AND advances the ref."""
+
+    def __init__(self, rng: np.random.Generator):
+        self.rng = rng
+        self.b = IRBuilder("fuzz")
+        c = int(rng.integers(1, 5))
+        h = int(rng.integers(4, 9))
+        w = int(rng.integers(4, 9))
+        self.shape = (1, c, h, w)
+        self.ref = rng.normal(size=self.shape).astype(np.float32)
+        #: the Parameter input fed at execution time
+        self.input = self.ref.copy()
+        self.cur = self.b.layer(
+            "Parameter",
+            {"shape": ",".join(map(str, self.shape)), "element_type": "f32"},
+            out_shapes=(self.shape,), name="input",
+        )
+
+    # -- helpers
+
+    def _apply(self, ltype, attrs, extra_inputs=(), out_shape=None,
+               n_outputs=1):
+        out_shape = out_shape or self.shape
+        inputs = [(self.cur[0], self.cur[1], self.shape)]
+        inputs += list(extra_inputs)
+        self.cur = self.b.layer(
+            ltype, attrs, inputs=inputs,
+            out_shapes=(out_shape,) * n_outputs,
+        )
+        self.shape = out_shape
+
+    def _const(self, arr):
+        ref = self.b.const(np.asarray(arr))
+        return (*ref, tuple(np.asarray(arr).shape))
+
+    # -- op pool (each returns None; mutates self.ref/self.shape)
+
+    def op_unary(self):
+        name, fn, attrs = self.rng.choice([
+            ("ReLU", lambda x: np.maximum(x, 0), {}),
+            ("Sigmoid", lambda x: 1 / (1 + np.exp(-x)), {}),
+            ("Tanh", np.tanh, {}),
+            ("Abs", np.abs, {}),
+            ("Exp", lambda x: np.exp(np.clip(x, -4, 2)), None),  # pre-clip
+            ("Clamp", lambda x: np.clip(x, -0.5, 1.5),
+             {"min": "-0.5", "max": "1.5"}),
+            ("Elu", lambda x: np.where(x > 0, x, 0.7 * (np.exp(x) - 1)),
+             {"alpha": "0.7"}),
+            ("HSigmoid", lambda x: np.clip((x + 3) / 6, 0, 1), {}),
+            ("Floor", np.floor, {}),
+            ("Negative", lambda x: -x, {}),
+            ("SoftPlus", lambda x: np.log1p(np.exp(x)), {}),
+        ], p=None)
+        if attrs is None:  # Exp: clamp first so values stay tame
+            self._apply("Clamp", {"min": "-4", "max": "2"})
+            self.ref = np.clip(self.ref, -4, 2)
+            self._apply("Exp", {})
+            self.ref = np.exp(self.ref)
+            return
+        self._apply(name, attrs)
+        self.ref = fn(self.ref).astype(np.float32)
+
+    def op_softmax(self):
+        axis = int(self.rng.integers(1, len(self.shape)))
+        self._apply("SoftMax", {"axis": str(axis)})
+        self.ref = _softmax(self.ref, axis)
+
+    def op_binary_const(self):
+        name, fn = self.rng.choice([
+            ("Add", np.add), ("Subtract", np.subtract),
+            ("Multiply", np.multiply), ("Maximum", np.maximum),
+            ("Minimum", np.minimum),
+        ])
+        c = self.shape[1]
+        shape = self.rng.choice([0, 1, 2])
+        cshape = [self.shape, (1, c, 1, 1), (1, 1, 1, 1)][shape]
+        arr = self.rng.normal(size=cshape).astype(np.float32)
+        self._apply(name, {}, extra_inputs=[self._const(arr)])
+        self.ref = fn(self.ref, arr).astype(np.float32)
+
+    def op_prelu(self):
+        c = self.shape[1]
+        slope = (self.rng.uniform(0.05, 0.5, (1, c, 1, 1))
+                 .astype(np.float32))
+        self._apply("PReLU", {}, extra_inputs=[self._const(slope)])
+        self.ref = np.where(self.ref >= 0, self.ref,
+                            self.ref * slope).astype(np.float32)
+
+    def op_conv(self):
+        _, c, h, w = self.shape
+        k = int(self.rng.choice([1, 3]))
+        s = int(self.rng.choice([1, 2]))
+        o = int(self.rng.integers(1, 5))
+        wgt = (self.rng.normal(size=(o, c, k, k)) / (c * k)).astype(
+            np.float32)
+        auto = bool(self.rng.integers(0, 2))
+        if auto:
+            pb, pe = _same_upper_pads(h, w, k, k, s, s)
+            # mo emits auto_pad plus (redundant) resolved pads;
+            # sometimes it omits the explicit ones — fuzz both
+            attrs = {"strides": f"{s},{s}", "auto_pad": "same_upper"}
+            if self.rng.integers(0, 2):
+                attrs.update({"pads_begin": f"{pb[0]},{pb[1]}",
+                              "pads_end": f"{pe[0]},{pe[1]}"})
+        else:
+            pb = pe = (k // 2, k // 2)
+            attrs = {"strides": f"{s},{s}",
+                     "pads_begin": f"{pb[0]},{pb[1]}",
+                     "pads_end": f"{pe[0]},{pe[1]}"}
+            if self.rng.integers(0, 2):
+                attrs["dilations"] = "1,1"  # mo sometimes omits it
+        ref = _np_conv(self.ref, wgt, (s, s), pb, pe)
+        self._apply("Convolution", attrs,
+                    extra_inputs=[self._const(wgt)],
+                    out_shape=ref.shape)
+        self.ref = ref
+
+    def op_depthwise(self):
+        _, c, h, w = self.shape
+        k = 3
+        wgt = (self.rng.normal(size=(c, 1, 1, k, k)) / k).astype(np.float32)
+        pb = pe = (1, 1)
+        ref = _np_conv(self.ref, wgt, (1, 1), pb, pe, groups=c)
+        self._apply(
+            "GroupConvolution",
+            {"strides": "1,1", "pads_begin": "1,1", "pads_end": "1,1",
+             "dilations": "1,1"},
+            extra_inputs=[self._const(wgt)], out_shape=ref.shape,
+        )
+        self.ref = ref
+
+    def op_pool(self):
+        _, c, h, w = self.shape
+        if h < 2 or w < 2:
+            return
+        kind = self.rng.choice(["max", "avg"])
+        ref = _np_pool(self.ref, 2, 2, kind)
+        attrs = {"kernel": "2,2", "strides": "2,2",
+                 "pads_begin": "0,0", "pads_end": "0,0",
+                 "rounding_type": "floor"}
+        if kind == "avg":
+            attrs["exclude-pad"] = "true"
+        self._apply("MaxPool" if kind == "max" else "AvgPool", attrs,
+                    out_shape=ref.shape)
+        self.ref = ref
+
+    def op_reduce_mean(self):
+        keep = bool(self.rng.integers(0, 2))
+        axes = np.asarray([2, 3], np.int64)
+        ref = self.ref.mean(axis=(2, 3), keepdims=keep)
+        self._apply("ReduceMean",
+                    {"keep_dims": "true" if keep else "false"},
+                    extra_inputs=[self._const(axes)], out_shape=ref.shape)
+        self.ref = ref.astype(np.float32)
+        if not keep:
+            # restore rank 4 for subsequent spatial ops
+            ref2 = self.ref.reshape(self.ref.shape + (1, 1))
+            tgt = np.asarray(ref2.shape, np.int64)
+            self._apply("Reshape", {"special_zero": "false"},
+                        extra_inputs=[self._const(tgt)],
+                        out_shape=ref2.shape)
+            self.ref = ref2
+
+    def op_transpose(self):
+        perm = list(self.rng.permutation(len(self.shape)))
+        ref = np.transpose(self.ref, perm)
+        self._apply("Transpose", {},
+                    extra_inputs=[self._const(np.asarray(perm, np.int64))],
+                    out_shape=ref.shape)
+        self.ref = ref
+
+    def op_unsqueeze_squeeze(self):
+        ax = int(self.rng.integers(0, len(self.shape) + 1))
+        ref = np.expand_dims(self.ref, ax)
+        self._apply("Unsqueeze", {},
+                    extra_inputs=[self._const(np.asarray([ax], np.int64))],
+                    out_shape=ref.shape)
+        self.ref = ref
+        self._apply("Squeeze", {},
+                    extra_inputs=[self._const(np.asarray([ax], np.int64))],
+                    out_shape=tuple(np.squeeze(ref, ax).shape))
+        self.ref = np.squeeze(ref, ax)
+
+    def op_concat_const(self):
+        c2 = int(self.rng.integers(1, 3))
+        arr = self.rng.normal(
+            size=(self.shape[0], c2) + self.shape[2:]).astype(np.float32)
+        ref = np.concatenate([self.ref, arr], axis=1)
+        self._apply("Concat", {"axis": "1"},
+                    extra_inputs=[self._const(arr)], out_shape=ref.shape)
+        self.ref = ref
+
+    def op_pad(self):
+        pads = [(0, 0), (0, 0),
+                tuple(self.rng.integers(0, 2, 2)),
+                tuple(self.rng.integers(0, 2, 2))]
+        pb = np.asarray([p[0] for p in pads], np.int64)
+        pe = np.asarray([p[1] for p in pads], np.int64)
+        ref = np.pad(self.ref, pads)
+        self._apply("Pad", {"pad_mode": "constant"},
+                    extra_inputs=[self._const(pb), self._const(pe)],
+                    out_shape=ref.shape)
+        self.ref = ref
+
+    def op_gather_channels(self):
+        c = self.shape[1]
+        n_idx = int(self.rng.integers(1, c + 1))
+        idx = self.rng.integers(0, c, n_idx).astype(np.int64)
+        ref = np.take(self.ref, idx, axis=1)
+        self._apply("Gather", {},
+                    extra_inputs=[
+                        self._const(idx),
+                        self._const(np.asarray(1, np.int64)),
+                    ],
+                    out_shape=ref.shape)
+        self.ref = ref
+
+    def op_batchnorm(self):
+        c = self.shape[1]
+        gamma = self.rng.uniform(0.5, 1.5, c).astype(np.float32)
+        beta = self.rng.normal(size=c).astype(np.float32)
+        mean = self.rng.normal(size=c).astype(np.float32)
+        var = self.rng.uniform(0.5, 2.0, c).astype(np.float32)
+        eps = 1e-5
+        sh = (1, c, 1, 1)
+        self._apply(
+            "BatchNormInference", {"epsilon": str(eps)},
+            extra_inputs=[self._const(gamma), self._const(beta),
+                          self._const(mean), self._const(var)],
+        )
+        self.ref = ((self.ref - mean.reshape(sh))
+                    / np.sqrt(var.reshape(sh) + eps)
+                    * gamma.reshape(sh) + beta.reshape(sh)).astype(np.float32)
+
+    def op_mvn(self):
+        across = bool(self.rng.integers(0, 2))
+        ax = tuple(range(1 if across else 2, len(self.shape)))
+        mu = self.ref.mean(axis=ax, keepdims=True)
+        var = ((self.ref - mu) ** 2).mean(axis=ax, keepdims=True)
+        eps = 1e-6
+        self._apply("MVN", {
+            "across_channels": "true" if across else "false",
+            "normalize_variance": "true", "eps": str(eps),
+        })
+        self.ref = ((self.ref - mu) / np.sqrt(var + eps)).astype(np.float32)
+
+    def op_fake_quantize(self):
+        lo, hi = -1.5, 1.5
+        levels = 256
+        self._apply(
+            "FakeQuantize", {"levels": str(levels)},
+            extra_inputs=[
+                self._const(np.float32(lo)), self._const(np.float32(hi)),
+                self._const(np.float32(lo)), self._const(np.float32(hi)),
+            ],
+        )
+        xc = np.clip(self.ref, lo, hi)
+        scale = (hi - lo) / (levels - 1)
+        q = np.round((xc - lo) / scale)
+        self.ref = (q * scale + lo).astype(np.float32)
+
+    def finish_matmul(self):
+        """Flatten → MatMul(+bias) tail, like every OMZ classifier."""
+        n = int(np.prod(self.shape))
+        tgt = np.asarray([1, n], np.int64)
+        self._apply("Reshape", {"special_zero": "false"},
+                    extra_inputs=[self._const(tgt)], out_shape=(1, n))
+        self.ref = self.ref.reshape(1, n)
+        m = int(self.rng.integers(2, 6))
+        tb = bool(self.rng.integers(0, 2))
+        wgt = (self.rng.normal(size=(m, n) if tb else (n, m)) / np.sqrt(n)
+               ).astype(np.float32)
+        self._apply("MatMul",
+                    {"transpose_a": "false",
+                     "transpose_b": "true" if tb else "false"},
+                    extra_inputs=[self._const(wgt)], out_shape=(1, m))
+        self.ref = (self.ref @ (wgt.T if tb else wgt)).astype(np.float32)
+        bias = self.rng.normal(size=(1, m)).astype(np.float32)
+        self._apply("Add", {}, extra_inputs=[self._const(bias)])
+        self.ref = self.ref + bias
+
+    OPS = [
+        "op_unary", "op_unary", "op_binary_const", "op_conv",
+        "op_depthwise", "op_pool", "op_reduce_mean", "op_transpose",
+        "op_unsqueeze_squeeze", "op_concat_const", "op_pad",
+        "op_gather_channels", "op_batchnorm", "op_mvn",
+        "op_fake_quantize", "op_prelu", "op_softmax",
+    ]
+
+    def build(self, tmp: Path, n_ops: int) -> Path:
+        for _ in range(n_ops):
+            name = self.rng.choice(self.OPS)
+            # spatial ops need rank 4
+            if len(self.shape) != 4 and name not in (
+                    "op_unary", "op_binary_const", "op_softmax"):
+                continue
+            if len(self.shape) == 4:
+                getattr(self, name)()
+            else:
+                getattr(self, self.rng.choice(
+                    ["op_unary", "op_softmax"]))()
+        if len(self.shape) == 4:
+            self.finish_matmul()
+        self.b.result((self.cur[0], self.cur[1], self.shape))
+        return self.b.write(tmp)
+
+
+# --------------------------------------------------------------- mo-ification
+
+
+def moify(xml_path: Path, rng: np.random.Generator) -> None:
+    """Inject Model-Optimizer artifacts the in-repo writer never emits."""
+    tree = ET.parse(xml_path)
+    root = tree.getroot()
+    # net-level rt_info + meta_data sections (mo >= 2022.1 emits both)
+    rt = ET.SubElement(root, "rt_info")
+    ET.SubElement(rt, "MO_version", {"value": "2022.3.0-fuzz"})
+    conv = ET.SubElement(rt, "conversion_parameters")
+    ET.SubElement(conv, "layout", {"value": "NCHW"})
+    meta = ET.SubElement(root, "meta_data")
+    ET.SubElement(meta, "cli_parameters")
+    for layer in root.iter("layer"):
+        # mixed opset tags per layer
+        layer.set("version",
+                  str(rng.choice(["opset1", "opset4", "opset8", "opset11"])))
+        # per-layer rt_info (fused-names hints)
+        lrt = ET.SubElement(layer, "rt_info")
+        ET.SubElement(lrt, "attribute", {
+            "name": "fused_names", "version": "0",
+            "value": layer.get("name", ""),
+        })
+        # precision attributes + names on every port
+        for port in layer.iter("port"):
+            port.set("precision", "FP32")
+            if rng.integers(0, 2):
+                port.set("names", f"t_{layer.get('id')}_{port.get('id')}")
+    tree.write(xml_path)
+
+
+# --------------------------------------------------------------------- tests
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_graph_roundtrip(tmp_path, seed):
+    """random graph → IRBuilder xml (+ mo artifacts) → load_ir →
+    forward == independent numpy evaluation."""
+    rng = np.random.default_rng(1000 + seed)
+    g = FuzzGraph(rng)
+    xml = g.build(tmp_path, n_ops=int(rng.integers(3, 9)))
+    moify(xml, rng)
+    model = load_ir(xml)
+    out = model.forward(model.params, g.input)
+    got = np.asarray(list(out.values())[0], np.float32)
+    np.testing.assert_allclose(got, g.ref, rtol=2e-3, atol=2e-3)
+
+
+def _compress_to_fp16(xml_path: Path, out_dir: Path) -> Path:
+    """Rewrite an IR pair with every f32 Const compressed to f16 —
+    the artifact ``mo --compress_to_fp16`` (and the OMZ FP16
+    precision directories, reference models_list/models.list.yml)
+    actually ships. Returns the new xml path."""
+    out_dir.mkdir(exist_ok=True)
+    blob = xml_path.with_suffix(".bin").read_bytes()
+    tree = ET.parse(xml_path)
+    new_blob = bytearray()
+    for layer in tree.getroot().iter("layer"):
+        if layer.get("type") != "Const":
+            continue
+        data = layer.find("data")
+        if data is None:
+            continue
+        off = int(data.get("offset", "0"))
+        size = int(data.get("size", "0"))
+        raw = blob[off:off + size]
+        if data.get("element_type") == "f32":
+            raw = np.frombuffer(raw, np.float32).astype(np.float16).tobytes()
+            data.set("element_type", "f16")
+        data.set("offset", str(len(new_blob)))
+        data.set("size", str(len(raw)))
+        new_blob.extend(raw)
+    out_xml = out_dir / "model.xml"
+    tree.write(out_xml)
+    (out_dir / "model.bin").write_bytes(bytes(new_blob))
+    return out_xml
+
+
+def test_fp16_compressed_ir_end_to_end(tmp_path):
+    """FP16-weights IR (the precision the reference downloads by
+    default) imports and serves: detector outputs match the FP32
+    import within fp16 tolerance on the full crossroad-shaped SSD."""
+    from evam_tpu.models.ir_build import build_crossroad_like_ir
+
+    xml32, _, _ = build_crossroad_like_ir(tmp_path, input_size=64, width=8)
+    xml16 = _compress_to_fp16(xml32, tmp_path / "fp16")
+    m32 = load_ir(xml32)
+    m16 = load_ir(xml16)
+    assert m16.is_detector and m16.anchors is not None
+    np.testing.assert_allclose(m16.anchors, m32.anchors, atol=1e-6)
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0, 255, (1, 3, 64, 64)).astype(np.float32)
+    o32 = m32.forward(m32.params, x)
+    o16 = m16.forward(m16.params, x)
+    assert set(o32) == set(o16)
+    for k in o32:
+        a32, a16 = np.asarray(o32[k]), np.asarray(o16[k])
+        assert a32.shape == a16.shape
+        # conf is post-softmax (≤1); loc deltas are O(1) — fp16
+        # weight rounding stays well under these bounds
+        np.testing.assert_allclose(a16, a32, atol=0.02)
+
+
+@pytest.mark.parametrize("seed", [3, 7])
+def test_nhwc_layout_pass_matches_nchw(tmp_path, seed):
+    """The import-time NHWC layout pass (EVAM_IR_LAYOUT) is a pure
+    execution-layout change: both layouts produce identical numerics
+    on fuzzed conv graphs."""
+    from evam_tpu.models.ir import build_ir_model, parse_ir
+
+    rng = np.random.default_rng(500 + seed)
+    g = FuzzGraph(rng)
+    xml = g.build(tmp_path, n_ops=6)
+    graph_a = parse_ir(xml)
+    graph_b = parse_ir(xml)
+    m_nchw = build_ir_model(graph_a, layout="nchw")
+    m_nhwc = build_ir_model(graph_b, layout="nhwc")
+    a = np.asarray(list(m_nchw.forward(m_nchw.params, g.input).values())[0])
+    b = np.asarray(list(m_nhwc.forward(m_nhwc.params, g.input).values())[0])
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_nhwc_pass_mixed_rank_eltwise(tmp_path):
+    """NHWC-pass regression: an eltwise mixing a conv output (rank 4,
+    NHWC) with a runtime rank-1 tensor must fall back to NCHW — a
+    rank-1 value NCHW-broadcasts to W but NHWC-broadcasts to C."""
+    b = IRBuilder("mixed_rank")
+    c, h, w = 3, 4, 4
+    p = b.layer("Parameter",
+                {"shape": f"1,{c},{h},{w}", "element_type": "f32"},
+                out_shapes=[(1, c, h, w)], name="input")
+    wgt = np.eye(c, dtype=np.float32).reshape(c, c, 1, 1)
+    wc = b.const(wgt)
+    conv = b.layer("Convolution",
+                   {"strides": "1,1", "pads_begin": "0,0",
+                    "pads_end": "0,0", "dilations": "1,1"},
+                   inputs=[(p[0], p[1], (1, c, h, w)),
+                           (*wc, wgt.shape)],
+                   out_shapes=[(1, c, h, w)])
+    # rank-1 runtime tensor: ReduceMean over (0,1,2) keep_dims=false
+    axes = b.const(np.asarray([0, 1, 2], np.int64))
+    red = b.layer("ReduceMean", {"keep_dims": "false"},
+                  inputs=[(conv[0], conv[1], (1, c, h, w)),
+                          (*axes, (3,))],
+                  out_shapes=[(w,)])
+    mul = b.layer("Multiply", {},
+                  inputs=[(conv[0], conv[1], (1, c, h, w)),
+                          (red[0], red[1], (w,))],
+                  out_shapes=[(1, c, h, w)])
+    b.result((mul[0], mul[1], (1, c, h, w)))
+    model = load_ir(b.write(tmp_path))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1, c, h, w)).astype(np.float32)
+    got = np.asarray(list(model.forward(model.params, x).values())[0])
+    ref = x * x.mean(axis=(0, 1, 2))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_nhwc_pass_const_fed_pool(tmp_path):
+    """NHWC-pass regression: a pool whose input is a Const must not
+    run with NHWC window dims (consts resolve untransposed)."""
+    b = IRBuilder("const_pool")
+    c, h, w = 4, 8, 8  # C == H/2 shapes would be silently wrong
+    p = b.layer("Parameter",
+                {"shape": "1,4,4,4", "element_type": "f32"},
+                out_shapes=[(1, 4, 4, 4)], name="input")
+    rng = np.random.default_rng(1)
+    carr = rng.normal(size=(1, c, h, w)).astype(np.float32)
+    cc = b.const(carr)
+    pool = b.layer("MaxPool",
+                   {"kernel": "2,2", "strides": "2,2",
+                    "pads_begin": "0,0", "pads_end": "0,0",
+                    "rounding_type": "floor"},
+                   inputs=[(*cc, carr.shape)],
+                   out_shapes=[(1, c, 4, 4)])
+    add = b.layer("Add", {},
+                  inputs=[(p[0], p[1], (1, 4, 4, 4)),
+                          (pool[0], pool[1], (1, c, 4, 4))],
+                  out_shapes=[(1, c, 4, 4)])
+    b.result((add[0], add[1], (1, c, 4, 4)))
+    model = load_ir(b.write(tmp_path))
+    x = rng.normal(size=(1, 4, 4, 4)).astype(np.float32)
+    got = np.asarray(list(model.forward(model.params, x).values())[0])
+    ref = x + _np_pool(carr, 2, 2, "max")
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_moified_minimal_graph_parses(tmp_path):
+    """The mo artifacts alone (rt_info/meta_data/opset tags/port
+    precision) must not confuse the parser even on a trivial graph."""
+    b = IRBuilder("mini")
+    p = b.layer("Parameter", {"shape": "1,3", "element_type": "f32"},
+                out_shapes=[(1, 3)])
+    r = b.layer("ReLU", {}, inputs=[(p[0], p[1], (1, 3))],
+                out_shapes=[(1, 3)])
+    b.result((r[0], r[1], (1, 3)))
+    xml = b.write(tmp_path)
+    moify(xml, np.random.default_rng(0))
+    model = load_ir(xml)
+    x = np.asarray([[-1.0, 0.0, 2.0]], np.float32)
+    got = np.asarray(model.forward(model.params, x)["relu_1"])
+    np.testing.assert_allclose(got, [[0.0, 0.0, 2.0]])

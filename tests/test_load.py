@@ -151,6 +151,58 @@ class TestMultiStreamLoad:
         assert "evam_stage_seconds" in text
 
 
+class TestDeviceSynthServe:
+    """bench.py --config serve --serve-ingest seed rides this mode:
+    stages submit uint32 seeds, engines synthesize wire batches
+    on-chip (steps.wrap_device_synth). The whole serving path must
+    behave identically — completion, batching, latency histogram."""
+
+    def test_synth_streams_complete_and_batch(self, eight_devices):
+        settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+        hub = EngineHub(
+            ModelRegistry(dtype="float32", input_overrides=SMALL,
+                          width_overrides=NARROW),
+            plan=build_mesh(), max_batch=16, deadline_ms=4.0,
+            device_synth=True,
+        )
+        reg = PipelineRegistry(settings, hub=hub)
+        try:
+            n, frames = 8, 12
+            instances = [
+                reg.start_instance(
+                    "object_tracking", "person_vehicle_bike",
+                    {
+                        "source": {
+                            "uri": f"synthetic://96x96@30?count={frames}"
+                                   f"&seed={i}",
+                            "type": "uri",
+                        },
+                        "destination": {"metadata": {"type": "null"}},
+                        "parameters": {"detection-threshold": 0.0},
+                    },
+                )
+                for i in range(n)
+            ]
+            deadline = time.time() + 240
+            for inst in instances:
+                inst.wait(timeout=max(1, deadline - time.time()))
+            states = [i.state.value for i in instances]
+            assert states.count("COMPLETED") == n, states
+            assert all(i._runner.frames_out == frames for i in instances)
+            stats = reg.hub.stats()
+            # detect→track→classify fuses into one engine (build.py
+            # _fusable: track/convert between them don't block fusion)
+            key = next(k for k in stats if k.startswith("detect"))
+            assert stats[key]["items"] >= n * frames
+            # cross-stream batching must still happen on the seed path
+            assert stats[key]["items"] / stats[key]["batches"] > 2.0, stats[key]
+            # end-to-end latency histogram populated (the serve bench's
+            # p50/p99 source)
+            assert metrics.quantile("evam_frame_latency_seconds", 0.5) > 0
+        finally:
+            reg.stop_all()
+
+
 class TestFaultInjection:
     def test_drop_and_error_rates(self):
         inj = FaultInjector("drop=0.5,error=0.0", seed=7)

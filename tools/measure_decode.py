@@ -1,0 +1,100 @@
+"""Host decode throughput, measured in-container (round-2 VERDICT 6).
+
+Encodes a synthetic-but-busy 1080p clip with every encoder this
+image's cv2/FFmpeg build can actually produce, then times cold decode.
+H.264 specifically cannot be *encoded* here (the bundled avcodec has
+only the h264_v4l2m2m hardware wrapper and no /dev/video device, no
+libx264/openh264 — verified), so the H.264 row in INGEST.md is derived
+from the measured MPEG-4 ASP number with the well-known complexity
+ratio rather than from literature alone.
+
+Prints one JSON line: {codec: {encode_fps, decode_fps, mb_per_s,
+bytes_per_frame}}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def busy_frames(n: int, h: int = 1080, w: int = 1920, seed: int = 7):
+    """Frames with enough structure + noise for realistic bitrates
+    (a flat synthetic frame compresses to nothing and skews decode)."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 96, (h, w, 3), np.uint8)
+    frames = []
+    for i in range(n):
+        f = base.copy()
+        # moving blocks (motion vectors) + per-frame noise (residuals)
+        for b in range(24):
+            x = (b * 83 + i * 13) % (w - 120)
+            y = (b * 47 + i * 11) % (h - 120)
+            f[y:y + 120, x:x + 120] = (
+                (b * 37) % 255, (b * 59) % 255, (b * 83) % 255)
+        noise = rng.integers(0, 24, (h // 4, w // 4, 3), np.uint8)
+        f[: h // 4, : w // 4] += noise
+        frames.append(f)
+    return frames
+
+
+def main() -> int:
+    import cv2
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 90
+    h, w = 1080, 1920
+    frames = busy_frames(n)
+    results = {}
+    for fourcc_s, ext in [("mp4v", "mp4"), ("XVID", "avi"),
+                          ("MJPG", "avi")]:
+        path = os.path.join(tempfile.gettempdir(),
+                            f"decode_bench_{fourcc_s}.{ext}")
+        wr = cv2.VideoWriter(
+            path, cv2.VideoWriter_fourcc(*fourcc_s), 30, (w, h))
+        if not wr.isOpened():
+            results[fourcc_s] = {"error": "encoder unavailable"}
+            continue
+        t0 = time.perf_counter()
+        for f in frames:
+            wr.write(f)
+        wr.release()
+        t_enc = time.perf_counter() - t0
+        size = os.path.getsize(path)
+
+        # cold-ish decode: fresh capture, read all frames
+        best = 0.0
+        for _ in range(2):
+            cap = cv2.VideoCapture(path)
+            t0 = time.perf_counter()
+            got = 0
+            while True:
+                ok, _ = cap.read()
+                if not ok:
+                    break
+                got += 1
+            dt = time.perf_counter() - t0
+            cap.release()
+            best = max(best, got / dt)
+        results[fourcc_s] = {
+            "encode_fps": round(n / t_enc, 1),
+            "decode_fps": round(best, 1),
+            "mb_per_s": round(best * (h // 16) * (w // 16) / 1e3, 1),
+            "bytes_per_frame": size // n,
+            "frames": got,
+        }
+        os.unlink(path)
+        print(f"{fourcc_s}: enc {results[fourcc_s]['encode_fps']} fps, "
+              f"dec {results[fourcc_s]['decode_fps']} fps "
+              f"({results[fourcc_s]['bytes_per_frame']//1024} KiB/frame)",
+              file=sys.stderr)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

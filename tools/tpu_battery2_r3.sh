@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-3 part-2 battery: everything the round-3 VERDICT asked for that
+# needs the real chip — run the moment the axon tunnel answers again
+# (part 1 lost it mid-run at bench_int8_pallas).
+set -u
+OUT=${1:-/tmp/tpu_battery2_r3}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+FAILED=0
+run() {
+    name=$1; shift
+    echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+    timeout 1200 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+    local rc=$?
+    echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    return $rc
+}
+
+# 1. THE round-3 artifact: the real serving path on the TPU
+#    (source -> runner -> BatchEngine -> track -> classify -> meta ->
+#    publish), device-synth ingest, 64 streams.
+run serve python bench.py --config serve --streams 64 --seconds 24 --batch 256
+run serve_b128 python bench.py --config serve --streams 64 --seconds 16 --batch 128
+run serve_mqtt_32 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
+
+# 2. 40 ms p99 sweep for the record (VERDICT item 2; sla_met=false
+#    through the 66 ms tunnel is an honest artifact)
+run sweep40 python bench.py --sweep --seconds 40 --p99-target-ms 40
+
+# 3. re-measured action/audio with the fixed metric definitions,
+#    AFTER establishing whether block_until_ready even blocks for
+#    small programs on this backend (the r2 inconsistency suspect)
+run blocking python tools/verify_blocking.py
+run action python bench.py --config action --seconds 8
+run audio python bench.py --config audio --seconds 8
+
+# 4. NHWC layout pass: IR vs zoo gap (VERDICT item 4 done-criterion)
+run ir_layout python tools/profile_ir_layout.py
+
+# 5. IR-backed end-to-end serve (synthesized OMZ models + NHWC pass)
+IRDIR=$OUT/omz_models
+if [ ! -d "$IRDIR" ]; then
+    timeout 600 python -m evam_tpu.cli.main fetch-models --synthesize-omz \
+        --models-dir "$IRDIR" >"$OUT/fetch.log" 2>&1 || true
+fi
+run detect_ir python bench.py --config detect --models-dir "$IRDIR" --det-model omz512/1 --seconds 8
+run serve_ir python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR" --serve-pipeline object_detection/person_vehicle_bike
+
+# 6. on-device step times at serving batches (latency budget terms)
+run budget python tools/profile_budget.py
+
+# 7. host-ingest point (tunnel-bound here; recorded for completeness)
+run host python bench.py --ingest host --batch 8 --depth 2 --seconds 6
+
+echo "battery2 complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
+exit $((FAILED > 0))

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Poll the axon tunnel with a hard-timeout subprocess probe; the moment
+# it answers, fire the given battery script. Front-loads TPU work after
+# a wedge without burning attention on manual polling.
+#   tools/tpu_watch.sh tools/tpu_battery2_r3.sh /tmp/tpu_battery2_r3
+set -u
+BATTERY=${1:?battery script}
+OUT=${2:?output dir}
+MAX_WAIT_S=${3:-28800}
+cd "$(dirname "$0")/.."
+mkdir -p "$OUT"
+start=$(date +%s)
+while true; do
+    now=$(date +%s)
+    if [ $((now - start)) -gt "$MAX_WAIT_S" ]; then
+        echo "$(date -Is) giving up after ${MAX_WAIT_S}s" >> "$OUT/watch.log"
+        exit 1
+    fi
+    timeout 150 python - <<'EOF' >> "$OUT/watch.log" 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print("probe ok:", float(jax.jit(lambda a: (a @ a).sum())(x)))
+EOF
+    if [ $? -eq 0 ]; then
+        echo "$(date -Is) tunnel alive -> $BATTERY" >> "$OUT/watch.log"
+        bash "$BATTERY" "$OUT"
+        exit $?
+    fi
+    echo "$(date -Is) probe failed; retrying in 180s" >> "$OUT/watch.log"
+    sleep 180
+done

@@ -1,0 +1,107 @@
+"""Does block_until_ready actually block on the axon backend?
+
+Round-2's recorded action/audio rates (13.5k vs 157k "streams",
+PROFILE.md) were mutually inconsistent by ~10×, and both imply batch
+rates far above the measured ~66 ms/dispatch tunnel floor — the prime
+suspect is the bench's completion wait. This probe times the SAME
+small program three ways:
+
+  a) submit-only (no wait)            — pure dispatch enqueue rate
+  b) jax.block_until_ready(out)       — what bench.py's loop does
+  c) np.asarray(out)                  — forced device→host readback
+
+On a healthy backend (b) and (c) differ only by the copy time and
+both sit at/above the RPC floor; (b) ≈ (a) « (c) instead means
+block_until_ready returns before execution completes on this
+experimental platform, and every recorded number that relied on it
+for small programs must be re-derived from (c).
+
+Prints one JSON line with the three per-call times for the action
+encoder (b256) and the audio net (b256).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _time_mode(fn, params, n_calls, mode):
+    import jax
+
+    outs = []
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        out = fn(params, np.uint32(i))
+        if mode == "block":
+            jax.block_until_ready(out)
+        elif mode == "asarray":
+            np.asarray(out)
+        else:
+            outs.append(out)  # keep alive, no wait
+    if mode == "submit":
+        for o in outs:
+            np.asarray(o)  # drain at the end (not timed per-call)
+    return (time.perf_counter() - t0) / n_calls * 1e3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from evam_tpu.engine import steps as step_builders
+    from evam_tpu.models.registry import ModelRegistry
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}")
+    registry = ModelRegistry(dtype="bfloat16")
+    results = {}
+    for cfg, key, build, shape, dtype in [
+        ("action", "action_recognition/encoder",
+         step_builders.build_action_encode_step, None, jnp.uint8),
+        ("audio", "audio_detection/environment",
+         step_builders.build_audio_step, (256, 16000), jnp.int16),
+    ]:
+        model = registry.get(key)
+        if cfg == "action":
+            step = build(model, wire_format="i420")
+            h, w = model.preprocess.height, model.preprocess.width
+            shape = (256, h * 3 // 2, w)
+        else:
+            step = build(model)
+        params = jax.device_put(model.params)
+        n = int(np.prod(shape))
+        name = "windows" if cfg == "audio" else "frames"
+
+        def seeded(params, seed, _step=step, _n=n, _shape=shape,
+                   _dtype=dtype, _name=name):
+            bits = step_builders.weyl_bits(seed, _n)
+            data = (bits >> jnp.uint32(13)).astype(_dtype)
+            return _step(params, **{_name: data.reshape(_shape)})
+
+        fn = jax.jit(seeded)
+        np.asarray(fn(params, np.uint32(99)))  # compile + settle
+        row = {}
+        for mode in ("submit", "block", "asarray"):
+            row[f"{mode}_ms_per_call"] = round(
+                _time_mode(fn, params, 12, mode), 2)
+        # the verdict: does block track asarray or submit?
+        row["block_really_blocks"] = (
+            row["block_ms_per_call"]
+            > 0.5 * row["asarray_ms_per_call"]
+        )
+        results[cfg] = row
+        log(f"{cfg}: {row}")
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
