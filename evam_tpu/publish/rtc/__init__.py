@@ -1,0 +1,24 @@
+"""From-scratch WebRTC media plane (round-2 VERDICT item 7).
+
+The reference's docker-compose promises a WebRTC output destination
+(reference docker-compose.yml:51-52) backed by GStreamer's webrtcbin.
+This package is the TPU rebuild's equivalent, built the same way the
+repo's MQTT/RTSP stacks were — from the RFCs, on what the image
+actually provides:
+
+* ``stun``  — RFC 5389 STUN + ICE-lite responder (RFC 8445): pure
+  python, validated against the RFC 5769 test vectors.
+* ``dtls``  — DTLS 1.2 with the use_srtp extension via ctypes over
+  the system ``libssl.so.3`` (no headers needed); exports SRTP keying
+  material per RFC 5764.
+* ``srtp``  — SRTP AES_CM_128_HMAC_SHA1_80 protection (RFC 3711):
+  AES-CM key derivation + CTR keystream + HMAC-SHA1-80 auth tags,
+  validated against the RFC 3711 appendix-B vectors.
+* ``vp8``   — VP8 frames via the image's FFmpeg/libvpx (per-frame
+  WebM encode + EBML SimpleBlock extraction) and RFC 7741 RTP
+  payloading.
+* ``session`` — glue: UDP host candidate, ICE answer, DTLS
+  handshake, SRTP-protected RTP sender, SDP offer/answer.
+"""
+
+from evam_tpu.publish.rtc.stun import StunMessage  # noqa: F401

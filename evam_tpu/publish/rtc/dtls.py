@@ -1,0 +1,255 @@
+"""DTLS 1.2 + use_srtp via ctypes over the system OpenSSL 3.
+
+The image ships ``libssl.so.3``/``libcrypto.so.3`` (no headers, no
+pyOpenSSL), so the bindings are declared by hand: memory-BIO DTLS
+endpoints (the WebRTC pattern — datagrams are shuttled between the
+UDP socket and the BIO pair), the ``use_srtp`` extension negotiating
+SRTP_AES128_CM_SHA1_80, and RFC 5764 §4.2 keying-material export
+(client/server SRTP master keys + salts).
+
+Certificates are generated at startup with the ``openssl`` CLI
+(self-signed EC, like every browser's per-session WebRTC cert) and
+fingerprinted for the SDP ``a=fingerprint`` line.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import hashlib
+import os
+import subprocess
+import tempfile
+
+SRTP_PROFILE = "SRTP_AES128_CM_SHA1_80"
+EXPORT_LABEL = b"EXTRACTOR-dtls_srtp"
+KEY_MATERIAL_LEN = 2 * (16 + 14)  # client+server key(16) + salt(14)
+
+SSL_ERROR_WANT_READ = 2
+SSL_ERROR_WANT_WRITE = 3
+SSL_FILETYPE_PEM = 1
+
+
+class _SrtpProtectionProfile(ctypes.Structure):
+    _fields_ = [("name", ctypes.c_char_p), ("id", ctypes.c_ulong)]
+
+
+def _load():
+    ssl_path = ctypes.util.find_library("ssl") or "libssl.so.3"
+    crypto_path = ctypes.util.find_library("crypto") or "libcrypto.so.3"
+    crypto = ctypes.CDLL(crypto_path, mode=ctypes.RTLD_GLOBAL)
+    ssl = ctypes.CDLL(ssl_path)
+
+    P = ctypes.c_void_p
+    sigs = {
+        ssl: {
+            "DTLS_method": ([], P),
+            "SSL_CTX_new": ([P], P),
+            "SSL_CTX_free": ([P], None),
+            "SSL_CTX_use_certificate_file": ([P, ctypes.c_char_p,
+                                              ctypes.c_int], ctypes.c_int),
+            "SSL_CTX_use_PrivateKey_file": ([P, ctypes.c_char_p,
+                                             ctypes.c_int], ctypes.c_int),
+            "SSL_CTX_set_tlsext_use_srtp": ([P, ctypes.c_char_p],
+                                            ctypes.c_int),
+            "SSL_new": ([P], P),
+            "SSL_free": ([P], None),
+            "SSL_set_bio": ([P, P, P], None),
+            "SSL_set_accept_state": ([P], None),
+            "SSL_set_connect_state": ([P], None),
+            "SSL_do_handshake": ([P], ctypes.c_int),
+            "SSL_get_error": ([P, ctypes.c_int], ctypes.c_int),
+            "SSL_is_init_finished": ([P], ctypes.c_int),
+            "SSL_export_keying_material": (
+                [P, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                 ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+                 ctypes.c_int], ctypes.c_int),
+            "SSL_get_selected_srtp_profile": (
+                [P], ctypes.POINTER(_SrtpProtectionProfile)),
+            "SSL_ctrl": ([P, ctypes.c_int, ctypes.c_long, P],
+                         ctypes.c_long),
+            "SSL_read": ([P, ctypes.c_char_p, ctypes.c_int],
+                         ctypes.c_int),
+            "SSL_write": ([P, ctypes.c_char_p, ctypes.c_int],
+                          ctypes.c_int),
+            "SSL_shutdown": ([P], ctypes.c_int),
+        },
+        crypto: {
+            "BIO_new": ([P], P),
+            "BIO_s_mem": ([], P),
+            "BIO_read": ([P, ctypes.c_char_p, ctypes.c_int],
+                         ctypes.c_int),
+            "BIO_write": ([P, ctypes.c_char_p, ctypes.c_int],
+                          ctypes.c_int),
+            "BIO_ctrl_pending": ([P], ctypes.c_size_t),
+            "ERR_get_error": ([], ctypes.c_ulong),
+            "ERR_error_string_n": ([ctypes.c_ulong, ctypes.c_char_p,
+                                    ctypes.c_size_t], None),
+        },
+    }
+    for lib, table in sigs.items():
+        for name, (argtypes, restype) in table.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+    return ssl, crypto
+
+
+_SSL = None
+_CRYPTO = None
+
+
+def _libs():
+    global _SSL, _CRYPTO
+    if _SSL is None:
+        _SSL, _CRYPTO = _load()
+    return _SSL, _CRYPTO
+
+
+def generate_certificate(state_dir: str | None = None) -> tuple[str, str, str]:
+    """Self-signed EC cert via the openssl CLI →
+    (cert_path, key_path, sha256_fingerprint "AB:CD:…")."""
+    d = state_dir or tempfile.mkdtemp(prefix="evam_rtc_")
+    os.makedirs(d, exist_ok=True)
+    cert, key = os.path.join(d, "cert.pem"), os.path.join(d, "key.pem")
+    if not (os.path.exists(cert) and os.path.exists(key)):
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+             "ec_paramgen_curve:prime256v1", "-keyout", key, "-out",
+             cert, "-days", "30", "-nodes", "-subj", "/CN=evam-tpu"],
+            check=True, capture_output=True,
+        )
+    der = subprocess.run(
+        ["openssl", "x509", "-in", cert, "-outform", "DER"],
+        check=True, capture_output=True,
+    ).stdout
+    digest = hashlib.sha256(der).hexdigest().upper()
+    fp = ":".join(digest[i:i + 2] for i in range(0, len(digest), 2))
+    return cert, key, fp
+
+
+class DtlsEndpoint:
+    """One memory-BIO DTLS endpoint (server or client role).
+
+    Drive with ``put_datagram`` (network → rbio) and
+    ``take_datagrams`` (wbio → network); ``handshake_step`` pumps the
+    state machine. After completion, ``srtp_keys()`` returns the
+    (local_key, local_salt, remote_key, remote_salt) for our sender
+    direction per RFC 5764 §4.2 key layout.
+    """
+
+    def __init__(self, cert_path: str, key_path: str,
+                 server: bool = True):
+        ssl, crypto = _libs()
+        self._ssl_lib, self._crypto = ssl, crypto
+        self.server = server
+        self.ctx = ssl.SSL_CTX_new(ssl.DTLS_method())
+        if not self.ctx:
+            raise RuntimeError("SSL_CTX_new failed")
+        if ssl.SSL_CTX_use_certificate_file(
+                self.ctx, cert_path.encode(), SSL_FILETYPE_PEM) != 1:
+            raise RuntimeError(self._err("use_certificate"))
+        if ssl.SSL_CTX_use_PrivateKey_file(
+                self.ctx, key_path.encode(), SSL_FILETYPE_PEM) != 1:
+            raise RuntimeError(self._err("use_privatekey"))
+        # 0 = success for this call (inverted vs most OpenSSL APIs)
+        if ssl.SSL_CTX_set_tlsext_use_srtp(
+                self.ctx, SRTP_PROFILE.encode()) != 0:
+            raise RuntimeError(self._err("set_tlsext_use_srtp"))
+        self.conn = ssl.SSL_new(self.ctx)
+        self.rbio = crypto.BIO_new(crypto.BIO_s_mem())
+        self.wbio = crypto.BIO_new(crypto.BIO_s_mem())
+        ssl.SSL_set_bio(self.conn, self.rbio, self.wbio)  # owns BIOs
+        if server:
+            ssl.SSL_set_accept_state(self.conn)
+        else:
+            ssl.SSL_set_connect_state(self.conn)
+
+    def _err(self, where: str) -> str:
+        buf = ctypes.create_string_buffer(256)
+        code = self._crypto.ERR_get_error()
+        self._crypto.ERR_error_string_n(code, buf, 256)
+        return f"{where}: {buf.value.decode()}"
+
+    # ------------------------------------------------------ datagrams
+
+    def put_datagram(self, data: bytes) -> None:
+        self._crypto.BIO_write(self.rbio, data, len(data))
+
+    def take_datagrams(self) -> list[bytes]:
+        out = []
+        while True:
+            pending = self._crypto.BIO_ctrl_pending(self.wbio)
+            if not pending:
+                break
+            buf = ctypes.create_string_buffer(int(pending))
+            n = self._crypto.BIO_read(self.wbio, buf, int(pending))
+            if n <= 0:
+                break
+            out.append(buf.raw[:n])
+        return out
+
+    # ------------------------------------------------------ handshake
+
+    def handshake_step(self) -> bool:
+        """Advance the handshake; True once complete."""
+        ssl = self._ssl_lib
+        if ssl.SSL_is_init_finished(self.conn):
+            return True
+        rc = ssl.SSL_do_handshake(self.conn)
+        if rc == 1:
+            return True
+        err = ssl.SSL_get_error(self.conn, rc)
+        if err in (SSL_ERROR_WANT_READ, SSL_ERROR_WANT_WRITE):
+            return False
+        raise RuntimeError(self._err(f"handshake (SSL_get_error={err})"))
+
+    def handle_timeout(self) -> None:
+        """Retransmit a lost flight (call on a ~1 s stall).
+        DTLSv1_handle_timeout is a macro: SSL_ctrl(ssl,
+        DTLS_CTRL_HANDLE_TIMEOUT=74, 0, NULL)."""
+        self._ssl_lib.SSL_ctrl(self.conn, 74, 0, None)
+
+    @property
+    def finished(self) -> bool:
+        return bool(self._ssl_lib.SSL_is_init_finished(self.conn))
+
+    # ----------------------------------------------------------- srtp
+
+    def selected_srtp_profile(self) -> str | None:
+        p = self._ssl_lib.SSL_get_selected_srtp_profile(self.conn)
+        return p.contents.name.decode() if p else None
+
+    def export_key_material(self) -> bytes:
+        buf = ctypes.create_string_buffer(KEY_MATERIAL_LEN)
+        rc = self._ssl_lib.SSL_export_keying_material(
+            self.conn, buf, KEY_MATERIAL_LEN,
+            EXPORT_LABEL, len(EXPORT_LABEL), None, 0, 0)
+        if rc != 1:
+            raise RuntimeError(self._err("export_keying_material"))
+        return buf.raw
+
+    def srtp_keys(self) -> tuple[bytes, bytes, bytes, bytes]:
+        """(local_key, local_salt, remote_key, remote_salt) — RFC 5764
+        §4.2 layout: client_key | server_key | client_salt |
+        server_salt; 'local' is our sending direction."""
+        m = self.export_key_material()
+        ck, sk = m[0:16], m[16:32]
+        cs, ss = m[32:46], m[46:60]
+        if self.server:
+            return sk, ss, ck, cs
+        return ck, cs, sk, ss
+
+    def close(self) -> None:
+        if getattr(self, "conn", None):
+            self._ssl_lib.SSL_free(self.conn)
+            self.conn = None
+        if getattr(self, "ctx", None):
+            self._ssl_lib.SSL_CTX_free(self.ctx)
+            self.ctx = None
+
+    def __del__(self):  # noqa: D105 — best-effort native cleanup
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,223 @@
+"""One WebRTC peer session: ICE-lite + DTLS-SRTP + VP8/RTP sender.
+
+Wires the package's layers onto a single UDP socket (rtcp-mux,
+BUNDLE): answers the browser's ICE connectivity checks, completes the
+DTLS handshake in the passive role, derives SRTP send keys (RFC
+5764), then encodes pipeline frames as VP8 keyframes and streams them
+SRTP-protected to the nominated remote address. The session is the
+media-plane counterpart of the reference's webrtcbin-based
+destination (reference docker-compose.yml:51-52).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import struct
+import threading
+import time
+
+from evam_tpu.obs import get_logger
+from evam_tpu.publish.rtc import dtls, srtp, stun, vp8
+
+log = get_logger("publish.rtc")
+
+PAYLOAD_TYPE = 96
+CLOCK_RATE = 90000
+
+
+def parse_remote_sdp(sdp: str) -> dict:
+    """The few offer fields the answering side uses."""
+    out: dict = {}
+    for pat, key in [
+        (r"^a=ice-ufrag:(\S+)", "ufrag"),
+        (r"^a=ice-pwd:(\S+)", "pwd"),
+        (r"^a=fingerprint:sha-256 (\S+)", "fingerprint"),
+        (r"^a=mid:(\S+)", "mid"),
+    ]:
+        m = re.search(pat, sdp, re.M)
+        if m and key not in out:
+            out[key] = m.group(1)
+    return out
+
+
+def build_answer_sdp(ip: str, port: int, ufrag: str, pwd: str,
+                     fingerprint: str, ssrc: int,
+                     mid: str = "0") -> str:
+    """Minimal browser-compatible answer: ice-lite, passive DTLS,
+    sendonly VP8 with a host candidate."""
+    sess = int.from_bytes(os.urandom(4), "big")
+    return "\r\n".join([
+        "v=0",
+        f"o=- {sess} 2 IN IP4 127.0.0.1",
+        "s=-",
+        "t=0 0",
+        "a=ice-lite",
+        f"a=group:BUNDLE {mid}",
+        "a=msid-semantic: WMS evam",
+        f"m=video {port} UDP/TLS/RTP/SAVPF {PAYLOAD_TYPE}",
+        f"c=IN IP4 {ip}",
+        f"a=mid:{mid}",
+        "a=sendonly",
+        f"a=ice-ufrag:{ufrag}",
+        f"a=ice-pwd:{pwd}",
+        f"a=fingerprint:sha-256 {fingerprint}",
+        "a=setup:passive",
+        "a=rtcp-mux",
+        f"a=rtpmap:{PAYLOAD_TYPE} VP8/{CLOCK_RATE}",
+        f"a=ssrc:{ssrc} cname:evam-tpu",
+        f"a=ssrc:{ssrc} msid:evam video0",
+        f"a=candidate:1 1 udp 2130706431 {ip} {port} typ host",
+        "a=end-of-candidates",
+        "",
+    ])
+
+
+class RtcSession:
+    """Answering media session for one viewer."""
+
+    def __init__(self, frame_source, width: int = 640, height: int = 360,
+                 bind_ip: str = "0.0.0.0", advertise_ip: str | None = None,
+                 cert_dir: str | None = None, fps: float = 15.0,
+                 on_dead=None):
+        """``frame_source() -> np.ndarray | None`` supplies BGR frames
+        (the publish relay's latest frame). ``on_dead(session)`` fires
+        once when the pump thread exits for any reason — owners use it
+        to release relay clients and registry slots."""
+        self.frame_source = frame_source
+        self.width, self.height = width, height
+        self.fps = fps
+        self.ssrc = int.from_bytes(os.urandom(4), "big") & 0x7FFFFFFF
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((bind_ip, 0))
+        self.sock.settimeout(0.2)
+        self.port = self.sock.getsockname()[1]
+        self.ip = advertise_ip or _default_ip()
+        cert, key, self.fingerprint = dtls.generate_certificate(cert_dir)
+        self.dtls = dtls.DtlsEndpoint(cert, key, server=True)
+        self.ice = stun.IceLiteResponder()
+        self.remote: dict = {}
+        self.sender: srtp.SrtpSender | None = None
+        self.connected = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.frames_sent = 0
+        self.on_dead = on_dead
+        self._dead_fired = False
+
+    # ------------------------------------------------------ signaling
+
+    def answer(self, offer_sdp: str) -> str:
+        self.remote = parse_remote_sdp(offer_sdp)
+        return build_answer_sdp(
+            self.ip, self.port, self.ice.local_ufrag,
+            self.ice.local_pwd, self.fingerprint, self.ssrc,
+            mid=self.remote.get("mid", "0"),
+        )
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"rtc-{self.port}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.sock.close()
+        self.dtls.close()
+
+    # ---------------------------------------------------------- pump
+
+    def _run(self) -> None:
+        try:
+            self._pump()
+        except Exception as exc:  # noqa: BLE001 — a dead session must
+            # never take the signaler down, and must always fire
+            # on_dead so the owner releases its relay client
+            log.warning("rtc session udp:%d died: %s", self.port, exc)
+        finally:
+            self._fire_dead()
+
+    def _fire_dead(self) -> None:
+        if self._dead_fired:
+            return
+        self._dead_fired = True
+        if self.on_dead is not None:
+            try:
+                self.on_dead(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _pump(self) -> None:
+        enc = vp8.Vp8Encoder(self.width, self.height)
+        pk = vp8.Vp8Packetizer(self.ssrc, PAYLOAD_TYPE)
+        last_dtls_progress = time.monotonic()
+        next_frame_t = 0.0
+        ts0 = int.from_bytes(os.urandom(4), "big") & 0xFFFFFF
+        t_start = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                try:
+                    data, addr = self.sock.recvfrom(4096)
+                except socket.timeout:
+                    data, addr = None, None
+                except OSError:
+                    break
+                if data is not None:
+                    if stun.is_stun(data):
+                        resp = self.ice.handle(data, addr)
+                        if resp is not None:
+                            self.sock.sendto(resp, addr)
+                    elif stun.is_dtls(data):
+                        self.dtls.put_datagram(data)
+                        last_dtls_progress = time.monotonic()
+                    # else: inbound RTCP (rtcp-mux) — sendonly, ignore
+
+                if self.ice.remote_addr is not None and not self.dtls.finished:
+                    self.dtls.handshake_step()
+                    for d in self.dtls.take_datagrams():
+                        self.sock.sendto(d, self.ice.remote_addr)
+                    if time.monotonic() - last_dtls_progress > 1.0:
+                        self.dtls.handle_timeout()
+                        last_dtls_progress = time.monotonic()
+
+                if self.dtls.finished and self.sender is None:
+                    key, salt, _rk, _rs = self.dtls.srtp_keys()
+                    self.sender = srtp.SrtpSender(key, salt)
+                    self.connected.set()
+                    log.info("rtc: media up to %s (%s)",
+                             self.ice.remote_addr,
+                             self.dtls.selected_srtp_profile())
+
+                now = time.monotonic()
+                if (self.sender is not None
+                        and self.ice.remote_addr is not None
+                        and now >= next_frame_t):
+                    next_frame_t = now + 1.0 / self.fps
+                    frame = self.frame_source()
+                    if frame is None:
+                        continue
+                    payload = enc.encode(frame)
+                    ts = (ts0 + int((now - t_start) * CLOCK_RATE)) \
+                        & 0xFFFFFFFF
+                    for pkt in pk.packetize(payload, ts):
+                        self.sock.sendto(
+                            self.sender.protect(pkt),
+                            self.ice.remote_addr)
+                    self.frames_sent += 1
+        finally:
+            enc.close()
+
+
+def _default_ip() -> str:
+    """Best-effort local address for the SDP host candidate."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -1,0 +1,178 @@
+"""VP8 frames + RFC 7741 RTP payloading.
+
+The image has no libvpx headers, but its FFmpeg build encodes VP8
+into WebM — so frames are encoded per-frame (keyframe-only, like the
+reference's MJPEG preview paths) through ``cv2.VideoWriter`` and the
+raw VP8 payload is lifted out of the WebM container with a minimal
+EBML walk (Segment → Cluster → SimpleBlock). Keyframe-only costs
+bitrate but removes all inter-frame encoder state, which is exactly
+right for a many-viewers preview stream (every viewer can join at any
+packet).
+
+``packetize`` implements the RFC 7741 VP8 payload descriptor in its
+minimal form (X=0, S set on the first fragment, PartID 0) over
+standard RTP headers.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+RTP_HEADER_LEN = 12
+DEFAULT_MTU = 1200  # typical WebRTC payload budget under 1500-byte UDP
+
+
+# ------------------------------------------------------------------ EBML walk
+
+
+def _read_vint(b: bytes, i: int, keep_marker: bool) -> tuple[int, int]:
+    first = b[i]
+    for n in range(8):
+        if first & (0x80 >> n):
+            if keep_marker:
+                val = first
+            else:
+                val = first & (0x7F >> n)
+            for k in range(n):
+                val = (val << 8) | b[i + 1 + k]
+            return val, i + n + 1
+    raise ValueError(f"bad EBML varint at {i}")
+
+
+_SEGMENT = 0x18538067
+_CLUSTER = 0x1F43B675
+_SIMPLEBLOCK = 0xA3
+
+
+def simple_blocks(webm: bytes) -> list[bytes]:
+    """Extract SimpleBlock payloads (track header stripped) from a
+    WebM byte string."""
+    out: list[bytes] = []
+
+    def walk(lo: int, hi: int) -> None:
+        i = lo
+        while i < hi:
+            eid, i = _read_vint(webm, i, keep_marker=True)
+            size, i = _read_vint(webm, i, keep_marker=False)
+            end = min(i + size, hi)
+            if eid in (_SEGMENT, _CLUSTER):
+                walk(i, end)
+            elif eid == _SIMPLEBLOCK:
+                # track number varint + int16 timecode + flags byte
+                _, j = _read_vint(webm, i, keep_marker=False)
+                out.append(webm[j + 3:end])
+            i = end
+
+    walk(0, len(webm))
+    return out
+
+
+def parse_vp8_header(payload: bytes) -> dict:
+    """First bytes of a VP8 frame (RFC 6386 §9.1): frame tag +
+    keyframe start code + dimensions."""
+    tag = payload[0] | (payload[1] << 8) | (payload[2] << 16)
+    info = {
+        "keyframe": (tag & 1) == 0,
+        "show_frame": bool((tag >> 4) & 1),
+        "first_part_size": tag >> 5,
+    }
+    if info["keyframe"]:
+        info["sync_ok"] = payload[3:6] == b"\x9d\x01\x2a"
+        info["width"] = ((payload[7] << 8) | payload[6]) & 0x3FFF
+        info["height"] = ((payload[9] << 8) | payload[8]) & 0x3FFF
+    return info
+
+
+# ----------------------------------------------------------------- encoder
+
+
+class Vp8Encoder:
+    """Per-frame VP8 via the image's FFmpeg (keyframe-only stream)."""
+
+    def __init__(self, width: int, height: int):
+        self.width = width
+        self.height = height
+        self._path = os.path.join(
+            tempfile.gettempdir(), f"evam_vp8_{os.getpid()}_{id(self)}.webm")
+
+    def encode(self, frame_bgr: np.ndarray) -> bytes:
+        """BGR frame → one raw VP8 keyframe payload."""
+        import cv2
+
+        if frame_bgr.shape[1] != self.width or frame_bgr.shape[0] != self.height:
+            frame_bgr = cv2.resize(frame_bgr, (self.width, self.height))
+        wr = cv2.VideoWriter(
+            self._path, cv2.VideoWriter_fourcc(*"VP80"), 30,
+            (self.width, self.height))
+        if not wr.isOpened():
+            raise RuntimeError("VP8 encoder unavailable in this build")
+        wr.write(frame_bgr)
+        wr.release()
+        with open(self._path, "rb") as f:
+            blocks = simple_blocks(f.read())
+        if not blocks:
+            raise RuntimeError("no VP8 frame produced")
+        return blocks[0]
+
+    def close(self) -> None:
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+
+# -------------------------------------------------------------- packetizer
+
+
+class Vp8Packetizer:
+    """VP8 frame → RTP packets (RFC 7741 minimal descriptor)."""
+
+    def __init__(self, ssrc: int, payload_type: int = 96,
+                 mtu: int = DEFAULT_MTU):
+        self.ssrc = ssrc
+        self.payload_type = payload_type
+        self.mtu = mtu
+        self.seq = int.from_bytes(os.urandom(2), "big")
+
+    def packetize(self, vp8_frame: bytes, timestamp: int) -> list[bytes]:
+        packets = []
+        budget = self.mtu - RTP_HEADER_LEN - 1  # 1-byte VP8 descriptor
+        chunks = [vp8_frame[i:i + budget]
+                  for i in range(0, len(vp8_frame), budget)]
+        for ci, chunk in enumerate(chunks):
+            marker = ci == len(chunks) - 1
+            header = struct.pack(
+                "!BBHII",
+                0x80,                                   # V=2, no P/X/CC
+                (0x80 if marker else 0) | self.payload_type,
+                self.seq & 0xFFFF,
+                timestamp & 0xFFFFFFFF,
+                self.ssrc,
+            )
+            self.seq = (self.seq + 1) & 0xFFFF
+            # VP8 payload descriptor: X=0 R=0 N=0 S(start) R=0 PID=0
+            descriptor = 0x10 if ci == 0 else 0x00
+            packets.append(header + bytes([descriptor]) + chunk)
+        return packets
+
+
+def depacketize(packets: list[bytes]) -> bytes:
+    """Reassemble a VP8 frame from its RTP packets (test harness for
+    the packetizer — the consumer role browsers play)."""
+    chunks = []
+    for i, pkt in enumerate(packets):
+        descriptor = pkt[RTP_HEADER_LEN]
+        if descriptor & 0x80:
+            raise ValueError("extended descriptor unexpected (X=0 mode)")
+        s_bit = bool(descriptor & 0x10)
+        if (i == 0) != s_bit:
+            raise ValueError("S bit must mark exactly the first packet")
+        chunks.append(pkt[RTP_HEADER_LEN + 1:])
+    marker = packets[-1][1] & 0x80
+    if not marker:
+        raise ValueError("last packet must carry the RTP marker")
+    return b"".join(chunks)

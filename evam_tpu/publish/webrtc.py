@@ -1,19 +1,25 @@
-"""WebRTC signaling destination.
+"""WebRTC destination: signaling client + real media plane.
 
 The reference enables a WebRTC frame destination by pointing at an
 external signaling server (``ENABLE_WEBRTC`` +
 ``WEBRTC_SIGNALING_SERVER`` ws endpoint, reference
-docker-compose.yml:51-52); media negotiation/transport live in that
-external stack, the service's job is to announce streams and feed
-frames. This client does the same over websockets: it registers each
-stream with the signaling server and, when asked to play, pushes
-JPEG frames as binary messages (the in-image stack has no DTLS/SRTP,
-so the frame channel is ws-binary MJPEG — the signaling contract and
-lifecycle match, the media encapsulation is documented here).
+docker-compose.yml:51-52). This client registers each stream there
+and serves viewers two ways:
+
+* **SDP offer/answer → real WebRTC media** (`publish/rtc/`): the peer
+  sends ``{"type": "offer", "sdp": ...}``; we answer with an ice-lite
+  + DTLS-passive SDP and stream SRTP-protected VP8 over UDP straight
+  to the viewer (STUN/DTLS/SRTP/RTP from scratch on the system
+  OpenSSL + FFmpeg-libvpx — see evam_tpu.publish.rtc).
+* **ws-MJPEG fallback** for minimal viewers: ``{"type": "play"}`` →
+  binary JPEG frames over the websocket itself.
 
 Protocol (JSON text frames, binary for media):
   -> {"type": "register", "stream": <name>}
-  <- {"type": "play", "stream": <name>}
+  <- {"type": "offer", "stream": <name>, "sdp": <offer>, "peer": id}
+  -> {"type": "answer", "stream": <name>, "sdp": <answer>, "peer": id}
+     (then SRTP media flows peer-to-peer over UDP)
+  <- {"type": "play", "stream": <name>}    # MJPEG fallback
   -> binary JPEG frames until
   <- {"type": "stop", "stream": <name>}
 """
@@ -37,6 +43,8 @@ class WebRtcSignaler:
         self.relay = relay
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        #: peer id -> live RtcSession (SDP-negotiated viewers)
+        self._sessions: dict = {}
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -46,6 +54,66 @@ class WebRtcSignaler:
 
     def stop(self) -> None:
         self._stop.set()
+        for peer in list(self._sessions):
+            self._drop_session(peer)
+
+    def _drop_session(self, peer: str) -> None:
+        """Stop + forget one media session, releasing its relay client
+        exactly once (idempotent: callable from 'bye', from the
+        session's on_dead, and from stop())."""
+        sess = self._sessions.pop(peer, None)
+        if sess is None:
+            return
+        self.relay.remove_client()
+        try:
+            sess.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+    def _frame_source(self):
+        """Latest relay JPEG decoded to BGR for the VP8 encoder
+        (gen 0 ⇒ any frame the relay currently holds qualifies)."""
+        import cv2
+        import numpy as np
+
+        jpeg, _ = self.relay.next_frame(0, timeout=0.5)
+        if jpeg is None:
+            return None
+        return cv2.imdecode(
+            np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
+
+    def _rtc_answer(self, offer_sdp: str, peer: str) -> str | None:
+        """Create a media session for one viewer; returns answer SDP."""
+        try:
+            from evam_tpu.publish.rtc.session import RtcSession
+        except Exception as exc:  # noqa: BLE001 — no OpenSSL/cv2 VP8
+            log.warning("webrtc media plane unavailable: %s", exc)
+            return None
+        # renegotiation: a fresh offer for a peer replaces (and stops)
+        # its previous session, keeping the relay client count balanced
+        self._drop_session(peer)
+        try:
+            sess = RtcSession(
+                self._frame_source,
+                on_dead=lambda s, _p=peer: self._on_session_dead(_p, s),
+            )
+            answer = sess.answer(offer_sdp)
+            sess.start()
+            self.relay.add_client()  # producers keep encoding frames
+            self._sessions[peer] = sess
+            log.info("webrtc: media session for peer %s on udp:%d",
+                     peer, sess.port)
+            return answer
+        except Exception as exc:  # noqa: BLE001 — answer failure ≠ crash
+            log.warning("webrtc: offer handling failed: %s", exc)
+            return None
+
+    def _on_session_dead(self, peer: str, sess) -> None:
+        """A session's pump thread exited (error or stop): release the
+        relay client unless a renegotiation already replaced it."""
+        if self._sessions.get(peer) is sess:
+            self._sessions.pop(peer, None)
+            self.relay.remove_client()
 
     def _run(self) -> None:
         asyncio.run(self._main())
@@ -79,7 +147,21 @@ class WebRtcSignaler:
                             data = json.loads(msg)
                             if data.get("stream") not in (None, self.stream):
                                 continue
-                            if data.get("type") == "play" and not playing:
+                            if data.get("type") == "offer":
+                                peer = str(data.get("peer", "0"))
+                                answer = self._rtc_answer(
+                                    data.get("sdp", ""), peer)
+                                if answer is not None:
+                                    await ws.send(json.dumps({
+                                        "type": "answer",
+                                        "stream": self.stream,
+                                        "peer": peer,
+                                        "sdp": answer,
+                                    }))
+                            elif data.get("type") == "bye":
+                                self._drop_session(
+                                    str(data.get("peer", "0")))
+                            elif data.get("type") == "play" and not playing:
                                 playing = True
                                 self.relay.add_client()
                             elif data.get("type") == "stop" and playing:

@@ -151,3 +151,70 @@ class TestWebRtcSignaler:
         signaler.stop()
         assert received["register"] == "cam0"
         assert received["frames"] >= 3
+
+    def test_sdp_offer_gets_media_answer(self):
+        """The signaler answers an SDP offer with a real ice-lite +
+        DTLS-passive + VP8 answer (the media plane itself is covered
+        end-to-end in tests/test_rtc.py)."""
+        import asyncio
+        import json
+
+        from evam_tpu.publish.webrtc import WebRtcSignaler
+
+        got = {"answer": None}
+        done = threading.Event()
+        port_holder = {"ready": threading.Event()}
+
+        offer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
+            "m=video 9 UDP/TLS/RTP/SAVPF 96",
+            "a=mid:0", "a=ice-ufrag:vuf", "a=ice-pwd:" + "v" * 22,
+            "a=fingerprint:sha-256 " + "CD:" * 31 + "CD",
+            "a=setup:active",
+        ])
+
+        async def server_main():
+            import websockets
+
+            async def handler(ws):
+                async for msg in ws:
+                    if isinstance(msg, (bytes, bytearray)):
+                        continue
+                    data = json.loads(msg)
+                    if data["type"] == "register":
+                        await ws.send(json.dumps({
+                            "type": "offer", "stream": data["stream"],
+                            "peer": "42", "sdp": offer,
+                        }))
+                    elif data["type"] == "answer":
+                        got["answer"] = data
+                        done.set()
+                        return
+
+            async with websockets.serve(handler, "127.0.0.1", 0) as server:
+                port_holder["port"] = server.sockets[0].getsockname()[1]
+                port_holder["ready"].set()
+                while not done.is_set():
+                    await asyncio.sleep(0.05)
+
+        server_thread = threading.Thread(
+            target=lambda: asyncio.run(server_main()), daemon=True)
+        server_thread.start()
+        assert port_holder["ready"].wait(5)
+
+        relay = FrameRelay("cam1")
+        signaler = WebRtcSignaler(
+            f"ws://127.0.0.1:{port_holder['port']}", "cam1", relay)
+        signaler.start()
+        try:
+            assert done.wait(30), "no SDP answer arrived"
+        finally:
+            signaler.stop()
+        ans = got["answer"]
+        assert ans["peer"] == "42"
+        sdp = ans["sdp"]
+        assert "a=ice-lite" in sdp
+        assert "a=setup:passive" in sdp
+        assert "a=fingerprint:sha-256" in sdp
+        assert "VP8/90000" in sdp
+        assert "typ host" in sdp
