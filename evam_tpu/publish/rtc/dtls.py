@@ -28,6 +28,16 @@ KEY_MATERIAL_LEN = 2 * (16 + 14)  # client+server key(16) + salt(14)
 SSL_ERROR_WANT_READ = 2
 SSL_ERROR_WANT_WRITE = 3
 SSL_FILETYPE_PEM = 1
+SSL_VERIFY_PEER = 0x01
+SSL_VERIFY_FAIL_IF_NO_PEER_CERT = 0x02
+
+#: verify callback that accepts any chain: WebRTC peers use
+#: self-signed per-session certs, so chain verification is
+#: meaningless — authentication is the SDP fingerprint pin, checked
+#: post-handshake via peer_fingerprint()
+_VERIFY_CB_TYPE = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.c_void_p)
+_accept_any_chain = _VERIFY_CB_TYPE(lambda _ok, _ctx: 1)
 
 
 class _SrtpProtectionProfile(ctypes.Structure):
@@ -73,8 +83,13 @@ def _load():
             "SSL_write": ([P, ctypes.c_char_p, ctypes.c_int],
                           ctypes.c_int),
             "SSL_shutdown": ([P], ctypes.c_int),
+            "SSL_CTX_set_verify": ([P, ctypes.c_int, P], None),
+            "SSL_get1_peer_certificate": ([P], P),
         },
         crypto: {
+            "i2d_X509": ([P, ctypes.POINTER(ctypes.c_void_p)],
+                         ctypes.c_int),
+            "X509_free": ([P], None),
             "BIO_new": ([P], P),
             "BIO_s_mem": ([], P),
             "BIO_read": ([P, ctypes.c_char_p, ctypes.c_int],
@@ -156,6 +171,14 @@ class DtlsEndpoint:
         if ssl.SSL_CTX_set_tlsext_use_srtp(
                 self.ctx, SRTP_PROFILE.encode()) != 0:
             raise RuntimeError(self._err("set_tlsext_use_srtp"))
+        # Require a peer certificate (both WebRTC roles present one);
+        # any chain is accepted here — the caller pins the SDP
+        # fingerprint against peer_fingerprint() after the handshake.
+        ssl.SSL_CTX_set_verify(
+            self.ctx,
+            SSL_VERIFY_PEER | SSL_VERIFY_FAIL_IF_NO_PEER_CERT,
+            _accept_any_chain,
+        )
         self.conn = ssl.SSL_new(self.ctx)
         self.rbio = crypto.BIO_new(crypto.BIO_s_mem())
         self.wbio = crypto.BIO_new(crypto.BIO_s_mem())
@@ -219,6 +242,26 @@ class DtlsEndpoint:
     def selected_srtp_profile(self) -> str | None:
         p = self._ssl_lib.SSL_get_selected_srtp_profile(self.conn)
         return p.contents.name.decode() if p else None
+
+    def peer_fingerprint(self) -> str | None:
+        """sha-256 fingerprint of the peer's certificate (DER),
+        "AB:CD:…" — compare against the remote SDP's a=fingerprint
+        (the ONLY peer authentication in WebRTC's DTLS)."""
+        x509 = self._ssl_lib.SSL_get1_peer_certificate(self.conn)
+        if not x509:
+            return None
+        try:
+            n = self._crypto.i2d_X509(x509, None)
+            if n <= 0:
+                return None
+            buf = ctypes.create_string_buffer(n)
+            ptr = ctypes.c_void_p(ctypes.addressof(buf))
+            self._crypto.i2d_X509(x509, ctypes.byref(ptr))
+            digest = hashlib.sha256(buf.raw[:n]).hexdigest().upper()
+            return ":".join(
+                digest[i:i + 2] for i in range(0, len(digest), 2))
+        finally:
+            self._crypto.X509_free(x509)
 
     def export_key_material(self) -> bytes:
         buf = ctypes.create_string_buffer(KEY_MATERIAL_LEN)

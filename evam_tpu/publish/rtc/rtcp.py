@@ -1,0 +1,89 @@
+"""Minimal RTCP for the sendonly media session (RFC 3550).
+
+Browsers use the Sender Report's NTP↔RTP timestamp mapping for A/V
+sync and stats, and the SDES CNAME to bind the SSRC to a source.
+One compound packet (SR + SDES) every few seconds is enough for a
+sendonly video session; it is SRTCP-protected by the caller with the
+same SRTP context family (RFC 3711 §3.4) — here the sender encrypts
+with its RTCP index and the E-bit, implemented in
+``SrtcpSender``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import struct
+import time
+
+from evam_tpu.publish.rtc import srtp
+
+NTP_EPOCH_OFFSET = 2208988800  # 1900 → 1970
+
+
+def ntp_now() -> tuple[int, int]:
+    t = time.time() + NTP_EPOCH_OFFSET
+    sec = int(t)
+    frac = int((t - sec) * (1 << 32)) & 0xFFFFFFFF
+    return sec & 0xFFFFFFFF, frac
+
+
+def sender_report(ssrc: int, rtp_ts: int, packets: int,
+                  octets: int, cname: str = "evam-tpu") -> bytes:
+    """Compound SR + SDES(CNAME)."""
+    ntp_s, ntp_f = ntp_now()
+    sr = struct.pack(
+        "!BBHIIIIII",
+        0x80,            # V=2, no padding, RC=0
+        200,             # PT=SR
+        6,               # length in 32-bit words - 1
+        ssrc & 0xFFFFFFFF,
+        ntp_s, ntp_f,
+        rtp_ts & 0xFFFFFFFF,
+        packets & 0xFFFFFFFF,
+        octets & 0xFFFFFFFF,
+    )
+    cname_b = cname.encode()
+    item = bytes([1, len(cname_b)]) + cname_b  # CNAME item
+    chunk = struct.pack("!I", ssrc & 0xFFFFFFFF) + item + b"\x00"
+    pad = (4 - len(chunk) % 4) % 4
+    chunk += b"\x00" * pad
+    sdes = struct.pack(
+        "!BBH", 0x81, 202, len(chunk) // 4) + chunk
+    return sr + sdes
+
+
+class SrtcpSender:
+    """SRTCP protection (RFC 3711 §3.4) for outgoing compound RTCP.
+
+    Same master secret as the RTP direction but the RTCP key-family
+    labels (3/4/5); the 31-bit index + E-bit trail the ciphertext,
+    then the 80-bit tag.
+    """
+
+    LABEL_RTCP_ENCRYPTION = 0x03
+    LABEL_RTCP_AUTH = 0x04
+    LABEL_RTCP_SALT = 0x05
+
+    def __init__(self, master_key: bytes, master_salt: bytes):
+        self.cipher_key, self.auth_key, self.salt = srtp.derive_keys(
+            master_key, master_salt,
+            labels=(self.LABEL_RTCP_ENCRYPTION, self.LABEL_RTCP_AUTH,
+                    self.LABEL_RTCP_SALT),
+        )
+        self.index = 0
+
+    def protect(self, rtcp: bytes) -> bytes:
+        ssrc = struct.unpack("!I", rtcp[4:8])[0]
+        index = self.index
+        self.index = (self.index + 1) & 0x7FFFFFFF
+        iv = srtp.packet_iv(self.salt, ssrc, index)
+        ks = srtp._aes_ctr_keystream(
+            self.cipher_key, iv, len(rtcp) - 8)
+        enc = rtcp[:8] + bytes(
+            b ^ k for b, k in zip(rtcp[8:], ks))
+        trailer = struct.pack("!I", 0x80000000 | index)  # E-bit set
+        tag = hmac.new(
+            self.auth_key, enc + trailer, hashlib.sha1,
+        ).digest()[:srtp.TAG_LEN]
+        return enc + trailer + tag

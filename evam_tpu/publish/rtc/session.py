@@ -19,7 +19,7 @@ import threading
 import time
 
 from evam_tpu.obs import get_logger
-from evam_tpu.publish.rtc import dtls, srtp, stun, vp8
+from evam_tpu.publish.rtc import dtls, rtcp, srtp, stun, vp8
 
 log = get_logger("publish.rtc")
 
@@ -77,15 +77,26 @@ def build_answer_sdp(ip: str, port: int, ufrag: str, pwd: str,
 class RtcSession:
     """Answering media session for one viewer."""
 
-    def __init__(self, frame_source, width: int = 640, height: int = 360,
+    def __init__(self, frame_source=None, width: int = 640,
+                 height: int = 360,
                  bind_ip: str = "0.0.0.0", advertise_ip: str | None = None,
                  cert_dir: str | None = None, fps: float = 15.0,
-                 on_dead=None):
+                 on_dead=None, connect_timeout_s: float = 30.0,
+                 payload_source=None):
         """``frame_source() -> np.ndarray | None`` supplies BGR frames
-        (the publish relay's latest frame). ``on_dead(session)`` fires
-        once when the pump thread exits for any reason — owners use it
-        to release relay clients and registry slots."""
+        (the publish relay's latest frame) which this session encodes
+        itself; ``payload_source() -> bytes | None`` supplies
+        ready-made VP8 payloads instead (SharedVp8Source: one encode
+        per relay frame shared across N viewers — the keyframe-only
+        stream is viewer-independent). Exactly one must be given.
+        ``on_dead(session)`` fires once when the pump thread exits for
+        any reason — owners use it to release relay clients and
+        registry slots."""
+        if (frame_source is None) == (payload_source is None):
+            raise ValueError(
+                "give exactly one of frame_source / payload_source")
         self.frame_source = frame_source
+        self.payload_source = payload_source
         self.width, self.height = width, height
         self.fps = fps
         self.ssrc = int.from_bytes(os.urandom(4), "big") & 0x7FFFFFFF
@@ -105,6 +116,14 @@ class RtcSession:
         self.frames_sent = 0
         self.on_dead = on_dead
         self._dead_fired = False
+        self._srtcp: rtcp.SrtcpSender | None = None
+        self._rtp_packets = 0
+        self._rtp_octets = 0
+        self._last_sr = 0.0
+        #: give up (and fire on_dead → relay release) if no viewer
+        #: completes ICE+DTLS in this window — an unreachable host
+        #: candidate must not pin encode cost forever
+        self.connect_timeout_s = connect_timeout_s
 
     # ------------------------------------------------------ signaling
 
@@ -151,7 +170,8 @@ class RtcSession:
                 pass
 
     def _pump(self) -> None:
-        enc = vp8.Vp8Encoder(self.width, self.height)
+        enc = (vp8.Vp8Encoder(self.width, self.height)
+               if self.payload_source is None else None)
         pk = vp8.Vp8Packetizer(self.ssrc, PAYLOAD_TYPE)
         last_dtls_progress = time.monotonic()
         next_frame_t = 0.0
@@ -184,31 +204,103 @@ class RtcSession:
                         last_dtls_progress = time.monotonic()
 
                 if self.dtls.finished and self.sender is None:
+                    # the SDP fingerprint is the peer's ONLY identity:
+                    # a handshake from a cert that doesn't match the
+                    # signaled offer is an impostor — tear down
+                    want = (self.remote.get("fingerprint") or "").upper()
+                    got = self.dtls.peer_fingerprint()
+                    if not want or got != want:
+                        raise RuntimeError(
+                            f"DTLS peer fingerprint mismatch: "
+                            f"offer={want[:20]}… peer="
+                            f"{(got or 'none')[:20]}…")
                     key, salt, _rk, _rs = self.dtls.srtp_keys()
                     self.sender = srtp.SrtpSender(key, salt)
+                    self._srtcp = rtcp.SrtcpSender(key, salt)
                     self.connected.set()
                     log.info("rtc: media up to %s (%s)",
                              self.ice.remote_addr,
                              self.dtls.selected_srtp_profile())
+
+                if (not self.connected.is_set()
+                        and time.monotonic() - t_start
+                        > self.connect_timeout_s):
+                    raise TimeoutError(
+                        f"no viewer connected within "
+                        f"{self.connect_timeout_s:.0f}s")
 
                 now = time.monotonic()
                 if (self.sender is not None
                         and self.ice.remote_addr is not None
                         and now >= next_frame_t):
                     next_frame_t = now + 1.0 / self.fps
-                    frame = self.frame_source()
-                    if frame is None:
-                        continue
-                    payload = enc.encode(frame)
+                    if enc is not None:
+                        frame = self.frame_source()
+                        if frame is None:
+                            continue
+                        payload = enc.encode(frame)
+                    else:
+                        payload = self.payload_source()
+                        if payload is None:
+                            continue
                     ts = (ts0 + int((now - t_start) * CLOCK_RATE)) \
                         & 0xFFFFFFFF
                     for pkt in pk.packetize(payload, ts):
                         self.sock.sendto(
                             self.sender.protect(pkt),
                             self.ice.remote_addr)
+                        self._rtp_packets += 1
+                        self._rtp_octets += len(pkt) - 12
                     self.frames_sent += 1
+                    # compound SR+SDES every ~2 s (browser sync/stats)
+                    if now - self._last_sr > 2.0:
+                        self._last_sr = now
+                        sr = rtcp.sender_report(
+                            self.ssrc, ts, self._rtp_packets,
+                            self._rtp_octets)
+                        self.sock.sendto(
+                            self._srtcp.protect(sr),
+                            self.ice.remote_addr)
         finally:
-            enc.close()
+            if enc is not None:
+                enc.close()
+
+
+class SharedVp8Source:
+    """One VP8 encode per relay frame, shared by every viewer session.
+
+    The stream is keyframe-only (vp8.Vp8Encoder), so the payload is
+    identical for all viewers; each session applies only its own RTP
+    seq/timestamp and SRTP protection. N viewers cost one encode,
+    not N (review finding r3)."""
+
+    def __init__(self, relay, width: int = 640, height: int = 360):
+        import threading as _t
+
+        self.relay = relay
+        self.enc = vp8.Vp8Encoder(width, height)
+        self._lock = _t.Lock()
+        self._gen = 0
+        self._payload: bytes | None = None
+
+    def payload(self) -> bytes | None:
+        import cv2
+        import numpy as np
+
+        jpeg, gen = self.relay.next_frame(self._gen, timeout=0.5)
+        if jpeg is None:
+            return self._payload  # stalled pipeline: resend last
+        with self._lock:
+            if gen != self._gen:
+                frame = cv2.imdecode(
+                    np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
+                if frame is not None:
+                    self._payload = self.enc.encode(frame)
+                    self._gen = gen
+        return self._payload
+
+    def close(self) -> None:
+        self.enc.close()
 
 
 def _default_ip() -> str:

@@ -37,12 +37,18 @@ def _aes_ctr_keystream(key: bytes, iv16: bytes, n: int) -> bytes:
     return enc.update(b"\x00" * n)
 
 
-def derive_keys(master_key: bytes, master_salt: bytes,
-                index: int = 0, kdr: int = 0) -> tuple[bytes, bytes, bytes]:
+def derive_keys(
+    master_key: bytes, master_salt: bytes,
+    index: int = 0, kdr: int = 0,
+    labels: tuple[int, int, int] = (
+        LABEL_RTP_ENCRYPTION, LABEL_RTP_AUTH, LABEL_RTP_SALT),
+) -> tuple[bytes, bytes, bytes]:
     """RFC 3711 §4.3.1 key derivation → (cipher_key, auth_key, salt).
 
     ``x = (label || index DIV kdr) XOR master_salt``, then AES-CM
-    keystream from ``x * 2^16`` under the master key.
+    keystream from ``x * 2^16`` under the master key. ``labels``
+    selects the key family: (0,1,2) for SRTP (default), (3,4,5) for
+    SRTCP (rtcp.SrtcpSender).
     """
     def prf(label: int, out_len: int) -> bytes:
         div = 0 if kdr == 0 else index // kdr
@@ -51,10 +57,11 @@ def derive_keys(master_key: bytes, master_salt: bytes,
         iv = (x << 16).to_bytes(16, "big")
         return _aes_ctr_keystream(master_key, iv, out_len)
 
+    enc_label, auth_label, salt_label = labels
     return (
-        prf(LABEL_RTP_ENCRYPTION, KEY_LEN),
-        prf(LABEL_RTP_AUTH, AUTH_KEY_LEN),
-        prf(LABEL_RTP_SALT, SALT_LEN),
+        prf(enc_label, KEY_LEN),
+        prf(auth_label, AUTH_KEY_LEN),
+        prf(salt_label, SALT_LEN),
     )
 
 

@@ -222,9 +222,11 @@ class IceLiteResponder:
         if msg.msg_type != BINDING_REQUEST:
             return None
         key = self.local_pwd.encode()
-        if msg.get(ATTR_MESSAGE_INTEGRITY) is not None:
-            if not msg.check_integrity(datagram, key):
-                return None  # bad credentials: drop, never answer
+        # RFC 8445 §7.2.2: connectivity checks MUST carry
+        # MESSAGE-INTEGRITY over our password — an unauthenticated
+        # request must never repoint the media destination
+        if not msg.check_integrity(datagram, key):
+            return None  # absent or bad credentials: drop silently
         self.remote_addr = addr
         if msg.get(ATTR_USE_CANDIDATE) is not None:
             self.nominated = True

@@ -43,8 +43,12 @@ class WebRtcSignaler:
         self.relay = relay
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        #: peer id -> live RtcSession (SDP-negotiated viewers)
+        #: peer id -> live RtcSession (SDP-negotiated viewers);
+        #: guarded by _sessions_lock (ws thread vs pump on_dead)
         self._sessions: dict = {}
+        self._sessions_lock = threading.Lock()
+        #: lazily-created shared VP8 encoder (SharedVp8Source)
+        self._vp8 = None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -56,36 +60,31 @@ class WebRtcSignaler:
         self._stop.set()
         for peer in list(self._sessions):
             self._drop_session(peer)
+        if self._vp8 is not None:
+            self._vp8.close()
+            self._vp8 = None
 
     def _drop_session(self, peer: str) -> None:
         """Stop + forget one media session, releasing its relay client
         exactly once (idempotent: callable from 'bye', from the
         session's on_dead, and from stop())."""
-        sess = self._sessions.pop(peer, None)
-        if sess is None:
-            return
-        self.relay.remove_client()
+        with self._sessions_lock:
+            sess = self._sessions.pop(peer, None)
+            if sess is None:
+                return
+            self.relay.remove_client()
         try:
             sess.stop()
         except Exception:  # noqa: BLE001 — teardown best-effort
             pass
 
-    def _frame_source(self):
-        """Latest relay JPEG decoded to BGR for the VP8 encoder
-        (gen 0 ⇒ any frame the relay currently holds qualifies)."""
-        import cv2
-        import numpy as np
-
-        jpeg, _ = self.relay.next_frame(0, timeout=0.5)
-        if jpeg is None:
-            return None
-        return cv2.imdecode(
-            np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
-
     def _rtc_answer(self, offer_sdp: str, peer: str) -> str | None:
         """Create a media session for one viewer; returns answer SDP."""
         try:
-            from evam_tpu.publish.rtc.session import RtcSession
+            from evam_tpu.publish.rtc.session import (
+                RtcSession,
+                SharedVp8Source,
+            )
         except Exception as exc:  # noqa: BLE001 — no OpenSSL/cv2 VP8
             log.warning("webrtc media plane unavailable: %s", exc)
             return None
@@ -93,14 +92,19 @@ class WebRtcSignaler:
         # its previous session, keeping the relay client count balanced
         self._drop_session(peer)
         try:
+            if self._vp8 is None:
+                # one encoder for every viewer of this stream (the
+                # keyframe-only payload is viewer-independent)
+                self._vp8 = SharedVp8Source(self.relay)
             sess = RtcSession(
-                self._frame_source,
+                payload_source=self._vp8.payload,
                 on_dead=lambda s, _p=peer: self._on_session_dead(_p, s),
             )
             answer = sess.answer(offer_sdp)
+            with self._sessions_lock:
+                self.relay.add_client()  # producers keep encoding
+                self._sessions[peer] = sess
             sess.start()
-            self.relay.add_client()  # producers keep encoding frames
-            self._sessions[peer] = sess
             log.info("webrtc: media session for peer %s on udp:%d",
                      peer, sess.port)
             return answer
@@ -110,8 +114,12 @@ class WebRtcSignaler:
 
     def _on_session_dead(self, peer: str, sess) -> None:
         """A session's pump thread exited (error or stop): release the
-        relay client unless a renegotiation already replaced it."""
-        if self._sessions.get(peer) is sess:
+        relay client unless a renegotiation already replaced it.
+        Check-and-pop under the lock so a concurrent 'bye' can't make
+        the relay count go down twice for one session."""
+        with self._sessions_lock:
+            if self._sessions.get(peer) is not sess:
+                return
             self._sessions.pop(peer, None)
             self.relay.remove_client()
 

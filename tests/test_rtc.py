@@ -190,14 +190,19 @@ class TestDtls:
         from evam_tpu.publish.rtc import dtls
 
         cert, key, _fp = dtls.generate_certificate(str(tmp_path))
+        ccert, ckey, client_fp = dtls.generate_certificate(
+            str(tmp_path / "client"))
         srv = dtls.DtlsEndpoint(cert, key, server=True)
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.bind(("127.0.0.1", 0))
         sock.settimeout(0.2)
         port = sock.getsockname()[1]
+        # the server requires a client certificate (WebRTC mutual-cert
+        # pattern); s_client presents one via -cert/-key
         proc = subprocess.Popen(
             ["openssl", "s_client", "-dtls1_2", "-use_srtp",
-             dtls.SRTP_PROFILE, "-connect", f"127.0.0.1:{port}"],
+             dtls.SRTP_PROFILE, "-cert", ccert, "-key", ckey,
+             "-connect", f"127.0.0.1:{port}"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
         )
@@ -216,11 +221,81 @@ class TestDtls:
             assert srv.finished, "no handshake with openssl s_client"
             assert srv.selected_srtp_profile() == dtls.SRTP_PROFILE
             assert len(srv.export_key_material()) == 60
+            # the peer fingerprint we compute matches the client
+            # cert's actual sha-256 (the SDP pin would verify)
+            assert srv.peer_fingerprint() == client_fp
         finally:
             proc.kill()
             proc.wait()
             sock.close()
             srv.close()
+
+
+class TestFingerprintPin:
+    def test_mismatched_fingerprint_kills_session(self, tmp_path):
+        """A DTLS peer whose cert does NOT match the offer's
+        a=fingerprint must never get SRTP keys (impostor guard)."""
+        import socket
+        import time
+
+        from evam_tpu.publish.rtc import dtls, stun as stun_m
+        from evam_tpu.publish.rtc.session import RtcSession
+
+        frame = np.zeros((90, 160, 3), np.uint8)
+        sess = RtcSession(lambda: frame, width=160, height=90,
+                          bind_ip="127.0.0.1", advertise_ip="127.0.0.1",
+                          cert_dir=str(tmp_path), fps=30.0)
+        dead = {"fired": False}
+        sess.on_dead = lambda s: dead.__setitem__("fired", True)
+        offer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
+            "m=video 9 UDP/TLS/RTP/SAVPF 96", "a=mid:0",
+            "a=ice-ufrag:x", "a=ice-pwd:" + "q" * 22,
+            "a=fingerprint:sha-256 " + "00:" * 31 + "00",  # wrong pin
+            "a=setup:active",
+        ])
+        ans = sess.answer(offer)
+        sess.start()
+        cert, key, _ = dtls.generate_certificate(str(tmp_path / "a"))
+        cli = dtls.DtlsEndpoint(cert, key, server=False)
+        viewer = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        viewer.bind(("127.0.0.1", 0))
+        viewer.settimeout(0.2)
+        target = ("127.0.0.1", sess.port)
+        try:
+            import re
+
+            pwd = re.search(r"a=ice-pwd:(\S+)", ans).group(1)
+            ufrag = re.search(r"a=ice-ufrag:(\S+)", ans).group(1)
+            check = stun_m.StunMessage(
+                stun_m.BINDING_REQUEST, b"\x21" * 12,
+                [(stun_m.ATTR_USERNAME, f"{ufrag}:x".encode()),
+                 (stun_m.ATTR_USE_CANDIDATE, b"")],
+            ).build(integrity_key=pwd.encode())
+            viewer.sendto(check, target)
+            deadline = time.time() + 15
+            while time.time() < deadline and not cli.finished:
+                cli.handshake_step()
+                for d in cli.take_datagrams():
+                    viewer.sendto(d, target)
+                try:
+                    data, _ = viewer.recvfrom(4096)
+                    if stun_m.is_dtls(data):
+                        cli.put_datagram(data)
+                except socket.timeout:
+                    pass
+            # whether or not the client saw Finished, the SERVICE must
+            # refuse: never connected, session torn down, no media
+            deadline = time.time() + 10
+            while time.time() < deadline and not dead["fired"]:
+                time.sleep(0.1)
+            assert dead["fired"], "mismatched-pin session kept running"
+            assert not sess.connected.is_set()
+            assert sess.frames_sent == 0
+        finally:
+            cli.close()
+            viewer.close()
+            sess.stop()
 
 
 class TestVp8:
@@ -285,6 +360,48 @@ class TestVp8:
         assert box[..., 2].mean() < 80       # low red
 
 
+class TestRtcp:
+    def test_sender_report_structure(self):
+        from evam_tpu.publish.rtc import rtcp
+
+        pkt = rtcp.sender_report(0xABCD, rtp_ts=1234, packets=10,
+                                 octets=9999, cname="cam0")
+        # SR header
+        assert pkt[0] == 0x80 and pkt[1] == 200
+        import struct as st
+
+        length = st.unpack("!H", pkt[2:4])[0]
+        assert length == 6  # SR body: 6 words after header word
+        assert st.unpack("!I", pkt[4:8])[0] == 0xABCD
+        assert st.unpack("!I", pkt[16:20])[0] == 1234   # RTP ts
+        assert st.unpack("!I", pkt[20:24])[0] == 10     # packet count
+        assert st.unpack("!I", pkt[24:28])[0] == 9999   # octet count
+        # compound: SDES follows
+        sdes_off = 4 * (length + 1)
+        assert pkt[sdes_off + 1] == 202
+        assert b"cam0" in pkt[sdes_off:]
+
+    def test_srtcp_protect_format(self):
+        from evam_tpu.publish.rtc import rtcp, srtp
+
+        s = rtcp.SrtcpSender(b"\x03" * 16, b"\x04" * 14)
+        sr = rtcp.sender_report(7, 1, 1, 1)
+        out = s.protect(sr)
+        # header clear, ciphertext, E|index trailer, 10-byte tag
+        assert out[:8] == sr[:8]
+        assert len(out) == len(sr) + 4 + srtp.TAG_LEN
+        import struct as st
+
+        trailer = st.unpack(
+            "!I", out[len(sr):len(sr) + 4])[0]
+        assert trailer & 0x80000000  # E-bit
+        assert trailer & 0x7FFFFFFF == 0  # first index
+        # second packet increments the index
+        out2 = s.protect(sr)
+        t2 = st.unpack("!I", out2[len(sr):len(sr) + 4])[0]
+        assert t2 & 0x7FFFFFFF == 1
+
+
 class TestRtcSessionEndToEnd:
     def test_viewer_receives_decodable_video(self, tmp_path):
         """Full media plane over a REAL UDP socket: a software viewer
@@ -302,6 +419,11 @@ class TestRtcSessionEndToEnd:
         from evam_tpu.publish.rtc import dtls, srtp, stun as stun_m, vp8
         from evam_tpu.publish.rtc.session import RtcSession, parse_remote_sdp
 
+        # --- viewer identity first: the offer must pin the viewer's
+        # REAL cert fingerprint (the session verifies it post-DTLS)
+        cert, key, viewer_fp = dtls.generate_certificate(
+            str(tmp_path / "v"))
+
         # --- service side
         frame = np.zeros((360, 640, 3), np.uint8)
         frame[100:260, 200:440] = (0, 255, 0)
@@ -312,7 +434,7 @@ class TestRtcSessionEndToEnd:
             "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
             "m=video 9 UDP/TLS/RTP/SAVPF 96",
             "a=mid:0", "a=ice-ufrag:remoteu", "a=ice-pwd:" + "p" * 22,
-            "a=fingerprint:sha-256 " + "AB:" * 31 + "AB", "a=setup:active",
+            f"a=fingerprint:sha-256 {viewer_fp}", "a=setup:active",
         ])
         answer = sess.answer(offer)
         ans = parse_remote_sdp(answer)
@@ -325,7 +447,6 @@ class TestRtcSessionEndToEnd:
         viewer.settimeout(0.2)
         target = ("127.0.0.1", sess.port)
 
-        cert, key, _ = dtls.generate_certificate(str(tmp_path / "v"))
         cli = dtls.DtlsEndpoint(cert, key, server=False)
         try:
             # ICE connectivity check, signed with the answer's ice-pwd
@@ -351,7 +472,7 @@ class TestRtcSessionEndToEnd:
                     data, _ = viewer.recvfrom(4096)
                     if stun_m.is_dtls(data):
                         cli.put_datagram(data)
-                    else:
+                    elif not 192 <= data[1] <= 223:  # RFC 5761 demux
                         media.append(data)
                 except socket.timeout:
                     pass
@@ -366,7 +487,8 @@ class TestRtcSessionEndToEnd:
                     data, _ = viewer.recvfrom(4096)
                 except socket.timeout:
                     continue
-                if not (stun_m.is_stun(data) or stun_m.is_dtls(data)):
+                if not (stun_m.is_stun(data) or stun_m.is_dtls(data)
+                        or 192 <= data[1] <= 223):
                     media.append(data)
                     if data[1] & 0x80:  # RTP marker: frame complete
                         first_ts = st.unpack("!I", media[0][4:8])[0]
@@ -406,6 +528,64 @@ class TestRtcSessionEndToEnd:
         assert sess.frames_sent >= 1
 
 
+class TestSignalingRelay:
+    def test_offer_answer_relay(self):
+        """tools/signaling_server.py relays watch→offer and
+        answer→viewer between two real ws clients (the deployment
+        topology: service + browser page + relay)."""
+        import asyncio
+        import json
+        import re
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "tools/signaling_server.py",
+             "--host", "127.0.0.1", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            m = re.search(r"ws://[\d.]+:(\d+)", line)
+            assert m, f"no port line: {line!r}"
+            url = f"ws://127.0.0.1:{m.group(1)}"
+
+            async def run():
+                import websockets
+
+                async with websockets.connect(url) as svc, \
+                        websockets.connect(url) as viewer:
+                    await svc.send(json.dumps(
+                        {"type": "register", "stream": "cam0"}))
+                    await asyncio.sleep(0.2)
+                    await viewer.send(json.dumps(
+                        {"type": "watch", "stream": "cam0",
+                         "sdp": "v=0-offer"}))
+                    offer = json.loads(await asyncio.wait_for(
+                        svc.recv(), 10))
+                    assert offer["type"] == "offer"
+                    assert offer["sdp"] == "v=0-offer"
+                    await svc.send(json.dumps({
+                        "type": "answer", "stream": "cam0",
+                        "peer": offer["peer"], "sdp": "v=0-answer"}))
+                    ans = json.loads(await asyncio.wait_for(
+                        viewer.recv(), 10))
+                    assert ans == {"type": "answer",
+                                   "sdp": "v=0-answer"}
+                    # unknown stream errors cleanly
+                    await viewer.send(json.dumps(
+                        {"type": "watch", "stream": "nope",
+                         "sdp": "x"}))
+                    err = json.loads(await asyncio.wait_for(
+                        viewer.recv(), 10))
+                    assert err["type"] == "error"
+
+            asyncio.run(run())
+        finally:
+            proc.kill()
+            proc.wait()
+
+
 class TestIceLite:
     def test_responder_answers_and_nominates(self):
         ice = stun.IceLiteResponder()
@@ -436,3 +616,14 @@ class TestIceLite:
         ).build(integrity_key=b"attacker-guess")
         assert ice.handle(req, ("198.51.100.7", 40000)) is None
         assert ice.remote_addr is None
+
+    def test_missing_integrity_dropped(self):
+        """RFC 8445 §7.2.2: a check with NO MESSAGE-INTEGRITY must not
+        repoint the media destination (off-path hijack guard)."""
+        ice = stun.IceLiteResponder()
+        req = stun.StunMessage(
+            stun.BINDING_REQUEST, b"\x07" * 12,
+            [(stun.ATTR_USE_CANDIDATE, b"")],
+        ).build(integrity_key=None)
+        assert ice.handle(req, ("203.0.113.9", 4444)) is None
+        assert ice.remote_addr is None and not ice.nominated

@@ -1,0 +1,94 @@
+"""Reference WebRTC signaling server for evam-tpu streams.
+
+The reference points EVAM at an external signaling stack
+(WEBRTC_SIGNALING_SERVER, reference docker-compose.yml:51-52); this
+is the matching in-repo implementation of that role: a tiny ws relay
+between publishing services and viewers.
+
+Protocol (JSON text frames):
+  service -> {"type": "register", "stream": s}
+  viewer  -> {"type": "watch", "stream": s, "sdp": <offer>}
+  relay   -> service: {"type": "offer", "stream": s, "peer": id,
+                        "sdp": <offer>}
+  service -> {"type": "answer", "stream": s, "peer": id,
+               "sdp": <answer>}
+  relay   -> viewer: {"type": "answer", "sdp": <answer>}
+  (media then flows service→viewer directly over SRTP/UDP)
+
+Run: python tools/signaling_server.py [--port 8443]
+Viewer page: deploy/webrtc_viewer.html
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+
+
+async def main() -> None:
+    import websockets
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8443)
+    args = p.parse_args()
+
+    services: dict[str, object] = {}      # stream -> service ws
+    viewers: dict[str, object] = {}       # peer id -> viewer ws
+    peer_ids = itertools.count(1)
+
+    async def handler(ws):
+        role, stream, peer = None, None, None
+        try:
+            async for raw in ws:
+                if isinstance(raw, (bytes, bytearray)):
+                    continue  # MJPEG fallback frames: not relayed here
+                msg = json.loads(raw)
+                t = msg.get("type")
+                if t == "register":
+                    role, stream = "service", msg["stream"]
+                    services[stream] = ws
+                    print(f"service registered: {stream}")
+                elif t == "watch":
+                    role, stream = "viewer", msg["stream"]
+                    peer = str(next(peer_ids))
+                    viewers[peer] = ws
+                    svc = services.get(stream)
+                    if svc is None:
+                        await ws.send(json.dumps(
+                            {"type": "error",
+                             "message": f"no such stream {stream}"}))
+                        continue
+                    await svc.send(json.dumps({
+                        "type": "offer", "stream": stream,
+                        "peer": peer, "sdp": msg["sdp"],
+                    }))
+                elif t == "answer":
+                    viewer = viewers.get(str(msg.get("peer")))
+                    if viewer is not None:
+                        await viewer.send(json.dumps(
+                            {"type": "answer", "sdp": msg["sdp"]}))
+        finally:
+            if role == "service" and services.get(stream) is ws:
+                del services[stream]
+            if peer is not None:
+                viewers.pop(peer, None)
+                svc = services.get(stream)
+                if svc is not None:
+                    try:
+                        await svc.send(json.dumps(
+                            {"type": "bye", "stream": stream,
+                             "peer": peer}))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    async with websockets.serve(handler, args.host, args.port) as server:
+        port = server.sockets[0].getsockname()[1]
+        print(f"signaling on ws://{args.host}:{port}", flush=True)
+        await asyncio.Future()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
