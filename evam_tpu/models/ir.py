@@ -834,6 +834,88 @@ def _jax_op(layer: IRLayer) -> Callable[..., Any]:
         return lambda x, y: fn(x, y)
     if t == "LogicalNot":
         return jnp.logical_not
+    if t == "TopK":
+        # opset3/11: inputs (data, k); attrs axis, mode, sort;
+        # outputs (values, indices). Static k (the fold pass resolves
+        # the k const) keeps shapes XLA-static.
+        axis = int(a.get("axis", "-1"))
+        largest = a.get("mode", "max") == "max"
+        idx_et = a.get("index_element_type", "i32")
+        sort_mode = a.get("sort", "value")
+
+        def topk(x, k):
+            kk = int(np.asarray(k).reshape(-1)[0])
+            xs = jnp.moveaxis(x, axis, -1)
+            src = xs if largest else -xs
+            vals, idxs = jax.lax.top_k(src, kk)
+            if not largest:
+                vals = -vals
+            if sort_mode == "index":
+                # elements ordered by ORIGINAL index, not by value
+                order = jnp.argsort(idxs, axis=-1)
+                vals = jnp.take_along_axis(vals, order, axis=-1)
+                idxs = jnp.take_along_axis(idxs, order, axis=-1)
+            vals = jnp.moveaxis(vals, -1, axis)
+            idxs = jnp.moveaxis(idxs, -1, axis)
+            return (vals, idxs.astype(
+                jnp.int64 if idx_et == "i64" else jnp.int32))
+        return topk
+    if t == "ReverseSequence":
+        batch_axis = int(a.get("batch_axis", "0"))
+        seq_axis = int(a.get("seq_axis", "1"))
+
+        def reverse_sequence(x, seq_lengths):
+            lens = jnp.asarray(seq_lengths).astype(jnp.int32)
+            t_len = x.shape[seq_axis]
+            pos = jnp.arange(t_len)
+            # per batch row: positions < len are mirrored, the tail
+            # stays in place (the ONNX/OpenVINO convention)
+            shape = [1] * x.ndim
+            shape[seq_axis] = t_len
+            pos_b = pos.reshape(shape)
+            lens_shape = [1] * x.ndim
+            lens_shape[batch_axis] = x.shape[batch_axis]
+            lens_b = lens.reshape(lens_shape)
+            src = jnp.where(pos_b < lens_b, lens_b - 1 - pos_b, pos_b)
+            return jnp.take_along_axis(
+                x, jnp.broadcast_to(src, x.shape), axis=seq_axis)
+        return reverse_sequence
+    if t == "CTCGreedyDecoder":
+        # opset1: logits [T, N, C], seq_mask [T, N] → [N, T, 1, 1]
+        # class ids, -1 padded; optional repeated-merge (the OMZ
+        # text-recognition head, e.g. text-recognition-0012).
+        merge = a.get("ctc_merge_repeated", "true").lower() in (
+            "1", "true")
+
+        def ctc_greedy(logits, seq_mask):
+            t_len, n, c = logits.shape
+            blank = c - 1  # OpenVINO convention: last class is blank
+            best = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [T,N]
+            mask = jnp.asarray(seq_mask).astype(bool)[:t_len]
+            keep = mask & (best != blank)
+            if merge:
+                # collapse repeats FIRST (classic CTC), then the blank
+                # filter above removes the separators
+                prev = jnp.concatenate(
+                    [jnp.full((1, n), -1, jnp.int32), best[:-1]], axis=0)
+                keep = keep & (best != prev)
+            # stable compaction per column: kept symbols first, -1 pad
+            keepT = keep.T                       # [N, T]
+            bestT = best.T
+            order = jnp.argsort(~keepT, axis=1, stable=True)
+            vals = jnp.take_along_axis(bestT, order, axis=1)
+            kept = jnp.take_along_axis(keepT, order, axis=1)
+            out = jnp.where(kept, vals, -1)
+            return out.reshape(n, t_len, 1, 1).astype(jnp.float32)
+        return ctc_greedy
+    if t == "HardSigmoid":
+        # opset1: alpha/beta arrive as const inputs
+        return lambda x, alpha, beta: jnp.clip(
+            x * jnp.asarray(alpha, x.dtype)
+            + jnp.asarray(beta, x.dtype), 0.0, 1.0)
+    if t == "Selu":
+        return lambda x, alpha, lam: jnp.asarray(lam, x.dtype) * jnp.where(
+            x > 0, x, jnp.asarray(alpha, x.dtype) * (jnp.exp(x) - 1))
     if t == "Select":
         return lambda c, a_, b_: jnp.where(c, a_, b_.astype(a_.dtype)
                                            if hasattr(b_, "astype") else b_)
@@ -1414,8 +1496,12 @@ def build_ir_model(
         for r in results:
             src = graph.edges.get((r.id, r.inputs[0].id))
             # Result names in MO exports carry layer suffixes; use the
-            # producing layer's friendly name.
+            # producing layer's friendly name. Multi-output layers
+            # (TopK values+indices, Split, …) share one layer name —
+            # disambiguate by source port.
             out_name = _sanitize(graph.layers[src[0]].name)
+            if any(w[0] == out_name for w in wanted):
+                out_name = f"{out_name}_p{src[1]}"
             wanted.append((out_name, *src))
 
     def _is_prob(lid: int) -> bool:

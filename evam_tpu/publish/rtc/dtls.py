@@ -102,9 +102,21 @@ def _load():
                                     ctypes.c_size_t], None),
         },
     }
+    #: OpenSSL 3 renamed SSL_get_peer_certificate (1.1) to
+    #: SSL_get1_peer_certificate (same up-ref semantics) — accept both
+    fallbacks = {
+        "SSL_get1_peer_certificate": "SSL_get_peer_certificate",
+    }
     for lib, table in sigs.items():
         for name, (argtypes, restype) in table.items():
-            fn = getattr(lib, name)
+            try:
+                fn = getattr(lib, name)
+            except AttributeError:
+                alt = fallbacks.get(name)
+                if alt is None:
+                    raise
+                fn = getattr(lib, alt)
+                setattr(lib, name, fn)
             fn.argtypes = argtypes
             fn.restype = restype
     return ssl, crypto

@@ -1220,6 +1220,105 @@ def test_round_sign_reducel1_ops(tmp_path):
                                np.abs(rounded).sum(axis=1))
 
 
+def _single_op_ir(tmp_path, ltype, attrs, in_shapes, out_shapes,
+                  consts=(), n_outputs=1):
+    """Parameter(+consts) → one op → Result(s); returns the model."""
+    b = IRBuilder("single_op")
+    p = b.layer("Parameter",
+                {"shape": ",".join(map(str, in_shapes[0])),
+                 "element_type": "f32"},
+                out_shapes=[tuple(in_shapes[0])], name="input")
+    inputs = [(p[0], p[1], tuple(in_shapes[0]))]
+    for arr in consts:
+        c = b.const(np.asarray(arr))
+        inputs.append((*c, tuple(np.asarray(arr).shape)))
+    op = b.layer(ltype, attrs, inputs=inputs,
+                 out_shapes=[tuple(s) for s in out_shapes])
+    for i, s in enumerate(out_shapes):
+        b.result((op[0], op[1] + i, tuple(s)))
+    return load_ir(b.write(tmp_path))
+
+
+def test_topk_op(tmp_path):
+    x = np.asarray([[3.0, 1.0, 4.0, 1.5, 9.0, 2.6]], np.float32)
+    m = _single_op_ir(
+        tmp_path, "TopK",
+        {"axis": "1", "mode": "max", "sort": "value",
+         "index_element_type": "i32"},
+        [x.shape], [(1, 3), (1, 3)],
+        consts=[np.asarray(3, np.int64)],
+    )
+    out = m.forward(m.params, x)
+    vals, idxs = (np.asarray(v) for v in out.values())
+    np.testing.assert_allclose(vals, [[9.0, 4.0, 3.0]])
+    np.testing.assert_array_equal(idxs, [[4, 2, 0]])
+
+    # sort="index": same elements ordered by original position
+    (tmp_path / "si").mkdir()
+    m2 = _single_op_ir(
+        tmp_path / "si", "TopK",
+        {"axis": "1", "mode": "max", "sort": "index",
+         "index_element_type": "i32"},
+        [x.shape], [(1, 3), (1, 3)],
+        consts=[np.asarray(3, np.int64)],
+    )
+    out2 = m2.forward(m2.params, x)
+    vals2, idxs2 = (np.asarray(v) for v in out2.values())
+    np.testing.assert_array_equal(idxs2, [[0, 2, 4]])
+    np.testing.assert_allclose(vals2, [[3.0, 4.0, 9.0]])
+
+
+def test_reverse_sequence_op(tmp_path):
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    lens = np.asarray([4, 6], np.int64)
+    m = _single_op_ir(
+        tmp_path, "ReverseSequence",
+        {"batch_axis": "0", "seq_axis": "1"},
+        [x.shape], [x.shape], consts=[lens],
+    )
+    got = np.asarray(list(m.forward(m.params, x).values())[0])
+    # row 0: first 4 reversed, tail unchanged; row 1: all 6 reversed
+    np.testing.assert_allclose(
+        got, [[3, 2, 1, 0, 4, 5], [11, 10, 9, 8, 7, 6]])
+
+
+def test_ctc_greedy_decoder_op(tmp_path):
+    # T=5, N=1, C=4 (class 3 = blank). argmax path: [2, 2, 3, 1, 1]
+    # → merge repeats → [2, 3, 1] → drop blank → [2, 1, -1, -1, -1]
+    t_len, n, c = 5, 1, 4
+    path = [2, 2, 3, 1, 1]
+    logits = np.full((t_len, n, c), -5.0, np.float32)
+    for t_i, cls in enumerate(path):
+        logits[t_i, 0, cls] = 5.0
+    mask = np.ones((t_len, n), np.float32)
+    m = _single_op_ir(
+        tmp_path, "CTCGreedyDecoder", {"ctc_merge_repeated": "true"},
+        [logits.shape], [(n, t_len, 1, 1)], consts=[mask],
+    )
+    got = np.asarray(list(m.forward(m.params, logits).values())[0])
+    np.testing.assert_allclose(
+        got.reshape(-1), [2, 1, -1, -1, -1])
+
+
+def test_hardsigmoid_selu_ops(tmp_path):
+    x = np.linspace(-3, 3, 7, dtype=np.float32).reshape(1, 7)
+    m = _single_op_ir(
+        tmp_path, "HardSigmoid", {}, [x.shape], [x.shape],
+        consts=[np.float32(0.2), np.float32(0.5)],
+    )
+    got = np.asarray(list(m.forward(m.params, x).values())[0])
+    np.testing.assert_allclose(got, np.clip(0.2 * x + 0.5, 0, 1),
+                               atol=1e-6)
+
+    m = _single_op_ir(
+        tmp_path, "Selu", {}, [x.shape], [x.shape],
+        consts=[np.float32(1.6733), np.float32(1.0507)],
+    )
+    got = np.asarray(list(m.forward(m.params, x).values())[0])
+    ref = 1.0507 * np.where(x > 0, x, 1.6733 * (np.exp(x) - 1))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
 def test_round_half_away_from_zero_mode(tmp_path):
     """Round's mode attribute: half_away_from_zero vs the half_to_even
     default differ exactly at .5 boundaries."""
