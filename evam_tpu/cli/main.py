@@ -95,10 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--size", type=int, default=None,
                    help="input resolution for --synthesize-omz "
                         "(default: 512 for ssd, 72 for attributes)")
-    f.add_argument("--topology", choices=["ssd", "attributes"],
+    f.add_argument("--topology",
+                   choices=["ssd", "attributes", "manifest"],
                    default="ssd",
                    help="--synthesize-omz topology: MobileNet-SSD "
-                        "detector or multi-head attributes classifier")
+                        "detector, multi-head attributes classifier, "
+                        "or 'manifest' = IR-backed stand-ins for ALL "
+                        "8 reference-manifest models (ALIAS ignored)")
     f.add_argument("--version", default="1")
     f.add_argument("--precision", default="FP32")
     f.set_defaults(fn=cmd_fetch_models)

@@ -247,6 +247,9 @@ def synthesize_omz(
         build_crossroad_like_ir,
     )
 
+    if topology == "manifest":
+        return _synthesize_manifest(output, precision)
+
     target = Path(output) / alias / version / precision
     if topology == "attributes":
         xml, _, meta = build_attributes_like_ir(
@@ -260,10 +263,70 @@ def synthesize_omz(
         )
         note = f"{meta['anchors']} anchors"
     else:
-        raise ValueError(f"unknown topology {topology!r} (ssd|attributes)")
+        raise ValueError(
+            f"unknown topology {topology!r} (ssd|attributes|manifest)")
     model = load_ir(xml)  # fail fast like --from-ir does
     log.info(
         "synthesized OMZ-shaped IR %s (input %s, %s) -> %s",
         alias, model.input_shape, note, target,
     )
+    return 0
+
+
+def _synthesize_manifest(output: str | Path, precision: str = "FP32") -> int:
+    """``--synthesize-omz --topology manifest``: materialize IR-backed
+    stand-ins for EVERY model in the reference manifest
+    (models_list/models.list.yml — the 8 models the reference's
+    model_downloader fetches from OMZ), each with its family's real
+    topology shape, into the serving layout. After this, the ENTIRE
+    pipeline catalog serves through the OpenVINO-IR ingestion path
+    with zero network access; real `mo` output installed later via
+    --from-ir simply replaces a directory.
+    """
+    from evam_tpu.models import ZOO_SPECS
+    from evam_tpu.models.ir import load_ir
+    from evam_tpu.models.ir_build import (
+        build_aclnet_like_ir,
+        build_action_decoder_like_ir,
+        build_action_encoder_like_ir,
+        build_attributes_like_ir,
+        build_crossroad_like_ir,
+    )
+
+    out = Path(output)
+    plans = [
+        # (key, builder, kwargs) — shapes follow the zoo/OMZ specs
+        ("object_detection/person_vehicle_bike", build_crossroad_like_ir,
+         {"input_size": 512, "width": 32, "num_classes": 4}),
+        ("object_detection/person", build_crossroad_like_ir,
+         {"input_size": (320, 544), "width": 24, "num_classes": 2}),
+        ("object_detection/vehicle", build_crossroad_like_ir,
+         {"input_size": 512, "width": 24, "num_classes": 2}),
+        ("face_detection_retail/1", build_crossroad_like_ir,
+         {"input_size": 300, "width": 16, "num_classes": 2}),
+        ("object_classification/vehicle_attributes",
+         build_attributes_like_ir,
+         {"input_size": 72, "width": 16,
+          "heads": (("color", 7), ("type", 4))}),
+        ("emotion_recognition/1", build_attributes_like_ir,
+         {"input_size": 64, "width": 16, "heads": (("emotion", 5),)}),
+        ("action_recognition/encoder", build_action_encoder_like_ir,
+         {"input_size": 224, "width": 16, "embed_dim": 512}),
+        ("action_recognition/decoder", build_action_decoder_like_ir,
+         {"clip_len": 16, "embed_dim": 512, "hidden": 64,
+          "num_classes": ZOO_SPECS["action_recognition/decoder"].num_classes}),
+        ("audio_detection/environment", build_aclnet_like_ir,
+         {"window": 16000, "width": 16,
+          "num_classes": ZOO_SPECS["audio_detection/environment"].num_classes}),
+    ]
+    for key, builder, kwargs in plans:
+        alias, _, version = key.partition("/")
+        target = out / alias / version / precision
+        xml, _, _meta = builder(target, **kwargs)
+        model = load_ir(xml)  # fail fast per model
+        log.info("manifest IR %s: input %s outputs %s -> %s",
+                 key, model.input_shape, model.output_names, target)
+    log.info(
+        "synthesized %d IR models (the 8 manifest entries; the action "
+        "composite is two IR dirs) under %s", len(plans), out)
     return 0

@@ -148,7 +148,7 @@ def conv_bias_relu(
 
 def build_crossroad_like_ir(
     target: Path,
-    input_size: int = 512,
+    input_size: int | tuple[int, int] = 512,
     width: int = 8,
     num_classes: int = 4,
     seed: int = 20260730,
@@ -157,7 +157,9 @@ def build_crossroad_like_ir(
 
     ``width`` is the first pointwise width (real 0078 uses 32); the
     depthwise ladder is the MobileNet-v1 stride pattern down to /16
-    with SSD heads on the /8 and /16 features.
+    with SSD heads on the /8 and /16 features. ``input_size`` may be
+    an int (square) or an (H, W) pair — person-detection-retail-0013
+    is 320×544.
     """
     rng = np.random.default_rng(seed)
     b = IRBuilder("omz_like_ssd")
@@ -167,12 +169,13 @@ def build_crossroad_like_ir(
         weights[name] = arr
         return b.const(arr, name)
 
-    s = input_size
+    ih, iw = ((input_size, input_size) if isinstance(input_size, int)
+              else (int(input_size[0]), int(input_size[1])))
     x = b.layer(
-        "Parameter", {"shape": f"1,3,{s},{s}", "element_type": "f32"},
-        out_shapes=((1, 3, s, s),), name="data",
+        "Parameter", {"shape": f"1,3,{ih},{iw}", "element_type": "f32"},
+        out_shapes=((1, 3, ih, iw),), name="data",
     )
-    cur, cur_shape = x, (1, 3, s, s)
+    cur, cur_shape = x, (1, 3, ih, iw)
 
     def conv(name, out_ch, kernel, stride, groups=1):
         nonlocal cur, cur_shape
@@ -202,7 +205,7 @@ def build_crossroad_like_ir(
     # --- SSD heads over the two scales ---
     anchors_per = 2
     loc_flats, conf_flats, prior_layers = [], [], []
-    img_shape_c = b.const(np.asarray([s, s], np.int64), "img_shape")
+    img_shape_c = b.const(np.asarray([ih, iw], np.int64), "img_shape")
 
     for idx, (feat, fshape) in enumerate(
         [(feat8, feat8_shape), (feat16, feat16_shape)]
@@ -259,7 +262,9 @@ def build_crossroad_like_ir(
 
         fs_c = b.const(np.asarray([fshape[2], fshape[3]], np.int64),
                        f"feat_shape{idx}")
-        step = s // fshape[2]
+        # the same stride ladder divides both dims, so H and W share
+        # one step even for rectangular inputs
+        step = ih // fshape[2]
         pri = b.layer(
             "PriorBoxClustered",
             {"width": f"{8.0 * (idx + 1)},{16.0 * (idx + 1)}",
@@ -384,3 +389,225 @@ def build_attributes_like_ir(
     xml = b.write(target)
     return xml, weights, {"heads": tuple(heads), "input_size": input_size,
                           "width": width}
+
+
+def build_action_encoder_like_ir(
+    target: Path,
+    input_size: int = 224,
+    width: int = 16,
+    embed_dim: int = 512,
+    seed: int = 20260732,
+):
+    """Write an action-recognition-0001-encoder-shaped IR: conv ladder
+    → global average pool → FC to a [1, D] embedding (no softmax —
+    the registry serves it through build_action_encode_step, which
+    consumes the raw embedding array). Returns (xml, weights, meta)."""
+    rng = np.random.default_rng(seed)
+    b = IRBuilder("action_encoder_like")
+    weights: dict[str, np.ndarray] = {}
+    s = input_size
+    x = b.layer("Parameter",
+                {"shape": f"1,3,{s},{s}", "element_type": "f32"},
+                out_shapes=((1, 3, s, s),), name="data")
+    cur, cur_shape = x, (1, 3, s, s)
+    for i, (ch, stride) in enumerate(
+            [(width, 2), (width * 2, 2), (width * 4, 2), (width * 8, 2)]):
+        cur, cur_shape = conv_bias_relu(
+            b, weights, rng, cur, cur_shape, f"enc{i}", ch, 3, stride)
+    _, c, h, w = cur_shape
+    pool = b.layer(
+        "AvgPool",
+        {"kernel": f"{h},{w}", "strides": "1,1", "pads_begin": "0,0",
+         "pads_end": "0,0", "exclude-pad": "true"},
+        inputs=[(cur[0], cur[1], cur_shape)],
+        out_shapes=((1, c, 1, 1),), name="gap",
+    )
+    tgt = b.const(np.asarray([1, c], np.int64), "flat_tgt")
+    flat = b.layer("Reshape", {"special_zero": "false"},
+                   inputs=[(pool[0], pool[1], (1, c, 1, 1)), (*tgt, (2,))],
+                   out_shapes=((1, c),), name="flat")
+    fc = (rng.normal(size=(c, embed_dim)) / np.sqrt(c)).astype(np.float32)
+    weights["embed_w"] = fc
+    fcc = b.const(fc, "embed_w")
+    emb = b.layer("MatMul",
+                  {"transpose_a": "false", "transpose_b": "false"},
+                  inputs=[(flat[0], flat[1], (1, c)), (*fcc, fc.shape)],
+                  out_shapes=((1, embed_dim),), name="embedding")
+    b.result((emb[0], emb[1], (1, embed_dim)))
+    target.mkdir(parents=True, exist_ok=True)
+    xml = b.write(target)
+    return xml, weights, {"embed_dim": embed_dim, "input_size": s}
+
+
+def build_action_decoder_like_ir(
+    target: Path,
+    clip_len: int = 16,
+    embed_dim: int = 512,
+    hidden: int = 64,
+    num_classes: int = 400,
+    seed: int = 20260733,
+    softmax_tail: bool = False,
+):
+    """Write an action-recognition-0001-decoder-shaped IR: clips
+    [1, T, D] → TensorIterator(LSTMCell over T, hidden/cell
+    back-edges) → last hidden → FC logits (the mo export shape;
+    ``softmax_tail=True`` appends an in-graph SoftMax, which the
+    importer's out_is_prob detection must honor). The recurrent
+    topology the reference's composite action model downloads
+    (models_list/action-recognition-0001.json). Returns (xml,
+    weights, meta)."""
+    rng = np.random.default_rng(seed)
+    t, d, hs = clip_len, embed_dim, hidden
+    w = (rng.normal(size=(4 * hs, d)) * 0.1).astype(np.float32)
+    r = (rng.normal(size=(4 * hs, hs)) * 0.1).astype(np.float32)
+    bias = np.zeros((4 * hs,), np.float32)
+    fc = (rng.normal(size=(hs, num_classes)) * 0.1).astype(np.float32)
+
+    body = IRBuilder("dbody")
+    bx = body.layer("Parameter",
+                    {"shape": f"1,1,{d}", "element_type": "f32"},
+                    out_shapes=((1, 1, d),), name="xt")
+    bh = body.layer("Parameter",
+                    {"shape": f"1,{hs}", "element_type": "f32"},
+                    out_shapes=((1, hs),), name="h_in")
+    bc_ = body.layer("Parameter",
+                     {"shape": f"1,{hs}", "element_type": "f32"},
+                     out_shapes=((1, hs),), name="c_in")
+    axes = body.const(np.asarray([1], np.int64), "sq_axes")
+    sq = body.layer("Squeeze",
+                    inputs=[(bx[0], bx[1], (1, 1, d)), (*axes, (1,))],
+                    out_shapes=((1, d),), name="squeeze")
+    wc = body.const(w, "W")
+    rc = body.const(r, "R")
+    bbc = body.const(bias, "B")
+    cell = body.layer(
+        "LSTMCell", {"hidden_size": str(hs)},
+        inputs=[(sq[0], sq[1], (1, d)), (bh[0], bh[1], (1, hs)),
+                (bc_[0], bc_[1], (1, hs)), (*wc, w.shape),
+                (*rc, r.shape), (*bbc, bias.shape)],
+        out_shapes=((1, hs), (1, hs)), name="cell",
+    )
+    r_h = body.result((cell[0], cell[1], (1, hs)))
+    r_c = body.result((cell[0], cell[1] + 1, (1, hs)))
+    body_xml = (f'<layers>{"".join(body.layers)}</layers>'
+                f'<edges>{"".join(body.edges)}</edges>')
+
+    b = IRBuilder("action_decoder_like")
+    b.blob = body.blob
+    b._next_id = 100
+    x = b.layer("Parameter",
+                {"shape": f"1,{t},{d}", "element_type": "f32"},
+                out_shapes=((1, t, d),), name="input")
+    h0 = b.const(np.zeros((1, hs), np.float32), "h0")
+    c0 = b.const(np.zeros((1, hs), np.float32), "c0")
+    ti_id = b._next_id
+    b._next_id += 1
+    b.layers.append(
+        f'<layer id="{ti_id}" name="ti" type="TensorIterator" '
+        'version="opset1">'
+        '<input>'
+        f'<port id="0"><dim>1</dim><dim>{t}</dim><dim>{d}</dim></port>'
+        f'<port id="1"><dim>1</dim><dim>{hs}</dim></port>'
+        f'<port id="2"><dim>1</dim><dim>{hs}</dim></port>'
+        '</input><output>'
+        f'<port id="3"><dim>1</dim><dim>{hs}</dim></port>'
+        '</output>'
+        '<port_map>'
+        f'<input external_port_id="0" internal_layer_id="{bx[0]}" '
+        'axis="1" stride="1" start="0"/>'
+        f'<input external_port_id="1" internal_layer_id="{bh[0]}"/>'
+        f'<input external_port_id="2" internal_layer_id="{bc_[0]}"/>'
+        f'<output external_port_id="3" internal_layer_id="{r_h[0]}"/>'
+        '</port_map>'
+        '<back_edges>'
+        f'<edge from-layer="{r_h[0]}" to-layer="{bh[0]}"/>'
+        f'<edge from-layer="{r_c[0]}" to-layer="{bc_[0]}"/>'
+        '</back_edges>'
+        f'<body>{body_xml}</body>'
+        '</layer>'
+    )
+    for to_port, (src_lid, src_port) in enumerate(
+            [(x[0], x[1]), h0[:2], c0[:2]]):
+        b.edges.append(
+            f'<edge from-layer="{src_lid}" from-port="{src_port}" '
+            f'to-layer="{ti_id}" to-port="{to_port}"/>'
+        )
+    fc_c = b.const(fc, "fc_w")
+    mm = b.layer("MatMul",
+                 {"transpose_a": "false", "transpose_b": "false"},
+                 inputs=[(ti_id, 3, (1, hs)), (*fc_c, fc.shape)],
+                 out_shapes=((1, num_classes),), name="logits")
+    tail = mm
+    if softmax_tail:
+        tail = b.layer("SoftMax", {"axis": "1"},
+                       inputs=[(mm[0], mm[1], (1, num_classes))],
+                       out_shapes=((1, num_classes),), name="probs")
+    b.result((tail[0], tail[1], (1, num_classes)))
+    target.mkdir(parents=True, exist_ok=True)
+    xml = b.write(target)
+    weights = {"W": w, "R": r, "B": bias, "fc_w": fc}
+    return xml, weights, {"clip_len": t, "hidden": hs,
+                          "num_classes": num_classes}
+
+
+def build_aclnet_like_ir(
+    target: Path,
+    window: int = 16000,
+    width: int = 16,
+    num_classes: int = 53,
+    seed: int = 20260734,
+):
+    """Write an aclnet-shaped audio classifier IR: raw waveform
+    [1, 1, 1, S] → strided 1-D convs (as Nx1-free (1,k) 2-D convs,
+    the OMZ aclnet lowering) → global pool → FC → SoftMax.
+    Returns (xml, weights, meta)."""
+    rng = np.random.default_rng(seed)
+    b = IRBuilder("aclnet_like")
+    weights: dict[str, np.ndarray] = {}
+    s = window
+    x = b.layer("Parameter",
+                {"shape": f"1,1,1,{s}", "element_type": "f32"},
+                out_shapes=((1, 1, 1, s),), name="data")
+    cur, cur_shape = x, (1, 1, 1, s)
+    for i, (ch, k, stride) in enumerate(
+            [(width, 9, 4), (width * 2, 9, 4), (width * 4, 9, 4)]):
+        _, in_ch, _, cw = cur_shape
+        ow = -(-cw // stride)
+        pad = max((ow - 1) * stride + k - cw, 0)
+        lo, hi = pad // 2, pad - pad // 2
+        wshape = (ch, in_ch, 1, k)
+        warr = (rng.normal(size=wshape)
+                * (1.5 / np.sqrt(in_ch * k))).astype(np.float32)
+        weights[f"a{i}_w"] = warr
+        wc = b.const(warr, f"a{i}_w")
+        out_shape = (1, ch, 1, ow)
+        cur = b.layer(
+            "Convolution",
+            {"strides": f"1,{stride}", "pads_begin": f"0,{lo}",
+             "pads_end": f"0,{hi}", "dilations": "1,1"},
+            inputs=[(cur[0], cur[1], cur_shape), (*wc, wshape)],
+            out_shapes=(out_shape,), name=f"a{i}",
+        )
+        cur = b.layer("ReLU", inputs=[(cur[0], cur[1], out_shape)],
+                      out_shapes=(out_shape,), name=f"a{i}_relu")
+        cur_shape = out_shape
+    _, c, _, cw = cur_shape
+    mean_axes = b.const(np.asarray([2, 3], np.int64), "gap_axes")
+    gap = b.layer("ReduceMean", {"keep_dims": "false"},
+                  inputs=[(cur[0], cur[1], cur_shape),
+                          (*mean_axes, (2,))],
+                  out_shapes=((1, c),), name="gap")
+    fc = (rng.normal(size=(c, num_classes)) / np.sqrt(c)).astype(np.float32)
+    weights["fc_w"] = fc
+    fcc = b.const(fc, "fc_w")
+    mm = b.layer("MatMul",
+                 {"transpose_a": "false", "transpose_b": "false"},
+                 inputs=[(gap[0], gap[1], (1, c)), (*fcc, fc.shape)],
+                 out_shapes=((1, num_classes),), name="logits")
+    sm = b.layer("SoftMax", {"axis": "1"},
+                 inputs=[(mm[0], mm[1], (1, num_classes))],
+                 out_shapes=((1, num_classes),), name="probs")
+    b.result((sm[0], sm[1], (1, num_classes)))
+    target.mkdir(parents=True, exist_ok=True)
+    xml = b.write(target)
+    return xml, weights, {"window": window, "num_classes": num_classes}

@@ -42,11 +42,12 @@ run ir_layout python tools/profile_ir_layout.py
 # 5. IR-backed end-to-end serve (synthesized OMZ models + NHWC pass)
 IRDIR=$OUT/omz_models
 if [ ! -d "$IRDIR" ]; then
-    timeout 600 python -m evam_tpu.cli.main fetch-models --synthesize-omz \
-        --models-dir "$IRDIR" >"$OUT/fetch.log" 2>&1 || true
+    timeout 900 python -m evam_tpu.cli.main fetch-models \
+        --synthesize-omz all --topology manifest --output "$IRDIR" \
+        >"$OUT/fetch.log" 2>&1 || true
 fi
-run detect_ir python bench.py --config detect --models-dir "$IRDIR" --det-model omz512/1 --seconds 8
-run serve_ir python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR" --serve-pipeline object_detection/person_vehicle_bike
+run detect_ir python bench.py --config detect --models-dir "$IRDIR" --seconds 8
+run serve_ir python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR"
 
 # 6. on-device step times at serving batches (latency budget terms)
 run budget python tools/profile_budget.py
