@@ -23,7 +23,7 @@ run() {
 #    publish), device-synth ingest, 64 streams.
 run serve python bench.py --config serve --streams 64 --seconds 24 --batch 256
 run serve_b128 python bench.py --config serve --streams 64 --seconds 16 --batch 128
-run serve_mqtt_32 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
+run serve_file_32 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
 
 # 2. 40 ms p99 sweep for the record (VERDICT item 2; sla_met=false
 #    through the 66 ms tunnel is an honest artifact)
