@@ -288,9 +288,16 @@ def run_serve_bench(args) -> dict:
         reg.stop_all()  # registry owns hub shutdown (stops engines too)
 
     best = max(windows, key=lambda wnd: wnd["streams"])
+    result_extra = {}
+    if best["streams"] <= 0:
+        # distinguish "the serving path is slow" from "nothing moved"
+        # (wedged backend mid-window) for the driver/battery logs
+        result_extra["error"] = (
+            f"no frames completed in any window (states: {states})")
     return {
         "metric": "serve_streams_30fps_per_chip",
         "value": round(best["streams"], 2),
+        **result_extra,
         "unit": "streams",
         "vs_baseline": round(best["streams"] / 16.0, 3),
         "n_instances": args.streams,

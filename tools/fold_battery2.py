@@ -1,0 +1,66 @@
+"""Fold /tmp battery2 results into committed artifacts.
+
+Run after tools/tpu_battery2_r3.sh completes (the tpu_watch.sh arm):
+
+    python tools/fold_battery2.py /tmp/tpu_battery2_r3
+
+Copies every parseable one-line JSON into BENCH_SERVE_r03.json (one
+object per entry) and prints a PROFILE.md-ready markdown section to
+stdout — paste/append, review, commit.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+ENTRIES = [
+    ("serve", "serving path, 64 streams, b256, seed ingest"),
+    ("serve_b128", "serving path, 64 streams, b128"),
+    ("serve_file_32", "serving path, 32 streams, file publish"),
+    ("serve_ir", "serving path, 64 streams, manifest IR models"),
+    ("detect_ir", "detect bench, manifest IR person_vehicle_bike"),
+    ("sweep40", "operating-point sweep @ p99<40ms"),
+    ("blocking", "block_until_ready probe (action/audio programs)"),
+    ("action", "action streams (enc+dec combined metric)"),
+    ("audio", "audio streams (window-rate/5 metric)"),
+    ("ir_layout", "NCHW-vs-NHWC IR executor gap"),
+    ("budget", "on-device step time + 40ms budget table"),
+    ("host", "host-ingest point (tunnel-bound here)"),
+]
+
+
+def main() -> int:
+    out_dir = Path(sys.argv[1] if len(sys.argv) > 1
+                   else "/tmp/tpu_battery2_r3")
+    folded: dict[str, object] = {}
+    lines = ["", "## Round 3 battery part 2 (real v5e, post-recovery)", ""]
+    for name, desc in ENTRIES:
+        path = out_dir / f"{name}.json"
+        if not path.exists():
+            lines.append(f"- `{name}`: (not run)")
+            continue
+        text = path.read_text().strip()
+        last = text.splitlines()[-1] if text else ""
+        try:
+            folded[name] = json.loads(last)
+            lines.append(f"- `{name}` ({desc}):")
+            lines.append(f"  `{last}`")
+        except json.JSONDecodeError:
+            folded[name] = {"unparsed": last[-300:]}
+            lines.append(f"- `{name}`: UNPARSED tail: `{last[-120:]}`")
+    if not folded:
+        print(f"refusing to fold: nothing parseable in {out_dir} "
+              "(wrong path, or the battery never ran)", file=sys.stderr)
+        return 1
+    repo = Path(__file__).resolve().parent.parent
+    dest = repo / "BENCH_SERVE_r03.json"
+    dest.write_text(json.dumps(folded, indent=1) + "\n")
+    print("\n".join(lines))
+    print(f"\n[folded {len(folded)} entries -> {dest}]", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
