@@ -24,7 +24,13 @@ EOF
     if [ $? -eq 0 ]; then
         echo "$(date -Is) tunnel alive -> $BATTERY" >> "$OUT/watch.log"
         bash "$BATTERY" "$OUT"
-        exit $?
+        rc=$?
+        # fold results into the repo immediately: if the round ends
+        # before a human/agent returns, the driver's end-of-round
+        # commit still captures BENCH_SERVE_r03.json
+        python tools/fold_battery2.py "$OUT" > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
+        echo "$(date -Is) battery rc=$rc; folded -> BENCH_SERVE_r03.json" >> "$OUT/watch.log"
+        exit $rc
     fi
     echo "$(date -Is) probe failed; retrying in 180s" >> "$OUT/watch.log"
     sleep 180
