@@ -101,6 +101,16 @@ class MetricsRegistry:
             hist = self._hists.get((name, _label_str(labels)))
             return hist.quantile(q) if hist else 0.0
 
+    def quantiles_by_label(self, name: str, q: float) -> dict[str, float]:
+        """All labeled series of one histogram → {label_str: quantile}
+        (the serve bench's per-stage latency decomposition)."""
+        with self._lock:
+            return {
+                labels: hist.quantile(q)
+                for (n, labels), hist in self._hists.items()
+                if n == name
+            }
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines: list[str] = []
