@@ -342,6 +342,42 @@ class FuzzGraph:
         })
         self.ref = ((self.ref - mu) / np.sqrt(var + eps)).astype(np.float32)
 
+    def op_hardsigmoid_selu(self):
+        if self.rng.integers(0, 2):
+            alpha, beta = 0.25, 0.4
+            self._apply("HardSigmoid", {},
+                        extra_inputs=[self._const(np.float32(alpha)),
+                                      self._const(np.float32(beta))])
+            self.ref = np.clip(alpha * self.ref + beta, 0, 1).astype(
+                np.float32)
+        else:
+            a_, l_ = 1.6733, 1.0507
+            self._apply("Selu", {},
+                        extra_inputs=[self._const(np.float32(a_)),
+                                      self._const(np.float32(l_))])
+            self.ref = (l_ * np.where(self.ref > 0, self.ref,
+                                      a_ * (np.exp(self.ref) - 1))
+                        ).astype(np.float32)
+
+    def op_topk_channels(self):
+        c = self.shape[1]
+        if c < 2:
+            return
+        k = int(self.rng.integers(1, c))
+        sort_mode = str(self.rng.choice(["value", "index"]))
+        out_shape = (self.shape[0], k) + self.shape[2:]
+        # consume only the values output (port 0); fuzz graphs stay
+        # single-path — the indices output is covered in test_ir.py
+        self._apply("TopK",
+                    {"axis": "1", "mode": "max", "sort": sort_mode,
+                     "index_element_type": "i32"},
+                    extra_inputs=[self._const(np.asarray(k, np.int64))],
+                    out_shape=out_shape, n_outputs=2)
+        idx = np.argsort(-self.ref, axis=1, kind="stable")[:, :k]
+        if sort_mode == "index":
+            idx = np.sort(idx, axis=1)
+        self.ref = np.take_along_axis(self.ref, idx, axis=1)
+
     def op_fake_quantize(self):
         lo, hi = -1.5, 1.5
         levels = 256
@@ -383,6 +419,7 @@ class FuzzGraph:
         "op_unsqueeze_squeeze", "op_concat_const", "op_pad",
         "op_gather_channels", "op_batchnorm", "op_mvn",
         "op_fake_quantize", "op_prelu", "op_softmax",
+        "op_hardsigmoid_selu", "op_topk_channels",
     ]
 
     def build(self, tmp: Path, n_ops: int) -> Path:
