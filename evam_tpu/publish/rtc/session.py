@@ -14,7 +14,6 @@ from __future__ import annotations
 import os
 import re
 import socket
-import struct
 import threading
 import time
 
