@@ -278,6 +278,39 @@ def test_separable_resize_matches_jax_image():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_wire_shape_helper():
+    """ops.color.wire_shape is THE format→shape rule (engine warmup,
+    device-synth wrapper and bench all derive from it)."""
+    from evam_tpu.ops.color import wire_shape
+
+    assert wire_shape("i420", 64, 64) == (96, 64)
+    assert wire_shape("bgr", 64, 64) == (64, 64, 3)
+    with pytest.raises(ValueError):
+        wire_shape("yuv422", 64, 64)
+    with pytest.raises(ValueError):
+        wire_shape("i420", 63, 64)  # i420 height%4 constraint
+
+
+def test_weyl_bits_generator():
+    """steps.weyl_bits: scalar seed → [n]; [B] seeds → [B, n];
+    deterministic in the seed; distinct seeds produce distinct
+    streams (the serving device-synth contract)."""
+    import jax.numpy as jnp
+
+    from evam_tpu.engine.steps import weyl_bits
+
+    a = np.asarray(weyl_bits(jnp.uint32(1), 16))
+    assert a.shape == (16,) and a.dtype == np.uint32
+    b = np.asarray(weyl_bits(jnp.uint32(1), 16))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(weyl_bits(jnp.uint32(2), 16))
+    assert (a != c).any()
+    batch = np.asarray(weyl_bits(jnp.asarray([1, 2], jnp.uint32), 16))
+    assert batch.shape == (2, 16)
+    np.testing.assert_array_equal(batch[0], a)
+    np.testing.assert_array_equal(batch[1], c)
+
+
 def test_i420_fused_resize_matches_decode_then_resize():
     """i420_resize_to_bgr == resize(i420_to_bgr(x)) up to chroma-phase
     rounding (linear resize commutes with the affine BT.601)."""
