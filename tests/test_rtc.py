@@ -298,6 +298,31 @@ class TestFingerprintPin:
             sess.stop()
 
 
+class TestConnectTimeout:
+    def test_unreached_session_fires_on_dead(self, tmp_path):
+        """A session whose viewer never completes ICE+DTLS must time
+        out and fire on_dead (the relay-client release path) instead
+        of encoding forever for nobody."""
+        import time
+
+        from evam_tpu.publish.rtc.session import RtcSession
+
+        dead = {"fired": False}
+        sess = RtcSession(
+            lambda: None, width=160, height=96,
+            bind_ip="127.0.0.1", advertise_ip="127.0.0.1",
+            cert_dir=str(tmp_path), connect_timeout_s=2.0,
+            on_dead=lambda s: dead.__setitem__("fired", True),
+        )
+        sess.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and not dead["fired"]:
+            time.sleep(0.1)
+        assert dead["fired"], "connect timeout never fired"
+        assert not sess.connected.is_set()
+        sess.stop()
+
+
 class TestVp8:
     def test_encode_extract_valid_keyframe(self):
         from evam_tpu.publish.rtc import vp8
