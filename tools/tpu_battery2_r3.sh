@@ -15,6 +15,9 @@ run() {
     local rc=$?
     echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
     [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    # fold after EVERY entry: if the round (or the tunnel) dies
+    # mid-battery, whatever already ran is in the repo working tree
+    python tools/fold_battery2.py "$OUT" > "$OUT/folded.md" 2>/dev/null || true
     return $rc
 }
 
