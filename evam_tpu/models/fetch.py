@@ -119,8 +119,11 @@ def fetch_models(
             if wpath.exists() and not force:
                 log.info("%s exists, skipping (use force=True)", wpath)
                 continue
+            # materializing weights IS the point here — random init is
+            # the intended source when nothing exists yet
             reg = ModelRegistry(models_dir=out_root, precision=precision,
-                                dtype="bfloat16" if precision == "BF16" else dtype)
+                                dtype="bfloat16" if precision == "BF16" else dtype,
+                                allow_random_weights=True)
             reg.save_weights(key, out_root)
             # save_weights writes under the zoo key; move if aliased
             src = out_root / key / precision / "weights.msgpack"

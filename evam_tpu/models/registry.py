@@ -20,6 +20,7 @@ Each LoadedModel exposes a pure ``forward`` suitable for `jax.jit` /
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable
@@ -39,6 +40,25 @@ from evam_tpu.obs import get_logger
 from evam_tpu.ops.preprocess import PreprocessSpec
 
 log = get_logger("models.registry")
+
+
+class MissingWeightsError(RuntimeError):
+    """No weights on disk for a model and random init is not allowed.
+
+    The reference serves whatever the model downloader installed
+    (README.md:44-52) and fails in OpenVINO when the IR is absent; a
+    framework that silently serves random-init weights instead is a
+    production footgun (round-3 VERDICT item 6). Benches and tests that
+    *want* hermetic random weights opt in via
+    ``EVAM_ALLOW_RANDOM_WEIGHTS=1`` or
+    ``ModelRegistry(allow_random_weights=True)``.
+    """
+
+
+def _env_allows_random() -> bool:
+    return os.environ.get("EVAM_ALLOW_RANDOM_WEIGHTS", "0").lower() in (
+        "1", "true", "yes", "on",
+    )
 
 
 @dataclass(frozen=True)
@@ -145,6 +165,12 @@ class LoadedModel:
     yolo_specs: list = field(default_factory=list)
     #: set when backed by an imported OpenVINO IR graph (models/ir.py)
     ir: Any = None
+    #: weight provenance — "msgpack" (loaded from disk), "ir-bin"
+    #: (IR .bin tensors), "ir-bin+override" (.bin + weights.msgpack
+    #: fine-tune), or "random" (deterministic init, opt-in only).
+    #: Default is deliberately "unknown" so a construction site that
+    #: forgets to set it is visible, not plausibly mislabeled.
+    weight_source: str = "unknown"
 
     @property
     def forward(self) -> Callable:
@@ -288,8 +314,15 @@ class ModelRegistry:
         dtype: str = "bfloat16",
         input_overrides: dict[str, tuple[int, int]] | None = None,
         width_overrides: dict[str, int] | None = None,
+        allow_random_weights: bool | None = None,
     ):
         self.models_dir = Path(models_dir) if models_dir else None
+        #: None → env EVAM_ALLOW_RANDOM_WEIGHTS (default: strict —
+        #: serving a weightless model fails loudly, VERDICT r3 item 6)
+        self.allow_random_weights = (
+            _env_allows_random() if allow_random_weights is None
+            else bool(allow_random_weights)
+        )
         # EVAM_PRECISION=int8 selects the quantized serving path in
         # one knob: int8 module variants computing over bf16 tensors
         # between layers, float weights on disk
@@ -344,7 +377,7 @@ class ModelRegistry:
         # precisions, mdt_schema.py:17-22)
         module = build_module(
             spec, {"quant": "INT8" in self.precision.upper()})
-        params = self._init_or_load_params(spec, module)
+        params, weight_source = self._init_or_load_params(spec, module)
 
         proc = self._find_model_proc(spec)
         model_labels = list(spec.labels)
@@ -373,6 +406,7 @@ class ModelRegistry:
             labels=model_labels,
             head_labels={k: list(v) for k, v in spec.head_labels},
             anchors=anchors,
+            weight_source=weight_source,
         )
 
     def _ir_xml_path(self, key: str) -> Path | None:
@@ -445,6 +479,7 @@ class ModelRegistry:
         )
 
         params = ir_model.params
+        weight_source = "ir-bin"
         # fine-tuned/updated weights dropped next to the IR override
         # the .bin tensors (same upgrade path as zoo models)
         override = xml_path.parent / "weights.msgpack"
@@ -452,6 +487,7 @@ class ModelRegistry:
             try:
                 params = serialization.from_bytes(
                     params, override.read_bytes())
+                weight_source = "ir-bin+override"
                 log.info("overrode IR weights for %s from %s", key, override)
             except Exception as exc:  # noqa: BLE001 — zoo-format msgpack
                 # a zoo-module msgpack can share this directory (the
@@ -503,7 +539,37 @@ class ModelRegistry:
             detector_kind=ir_model.detector_kind,
             yolo_specs=list(ir_model.yolo_specs),
             ir=ir_model,
+            weight_source=weight_source,
         )
+
+    def describe(self) -> list[dict[str, str]]:
+        """Per-model weight provenance WITHOUT loading anything —
+        served by ``GET /models`` so an operator can see whether a
+        model would serve real weights ("msgpack"/"ir-bin"), refuse to
+        load ("absent"), or fall back to random init ("random",
+        only when EVAM_ALLOW_RANDOM_WEIGHTS allows it)."""
+        out = []
+        for key in self.keys():
+            alias, _, version = key.rpartition("/")
+            if key in self._cache:
+                weights = self._cache[key].weight_source
+            elif (xml := self._ir_xml_path(key)) is not None:
+                # match _load_ir: an adjacent msgpack overrides .bin
+                weights = (
+                    "ir-bin+override"
+                    if (xml.parent / "weights.msgpack").exists()
+                    else "ir-bin"
+                )
+            elif (spec := ZOO_SPECS.get(key)) is not None \
+                    and self._weights_path(spec) is not None:
+                weights = "msgpack"
+            elif self.allow_random_weights:
+                weights = "random"
+            else:
+                weights = "absent"
+            out.append({"name": alias, "version": version,
+                        "weights": weights})
+        return out
 
     def _weights_path(self, spec: ModelSpec) -> Path | None:
         if not self.models_dir:
@@ -515,16 +581,35 @@ class ModelRegistry:
                 return p
         return None
 
-    def _init_or_load_params(self, spec: ModelSpec, module) -> Any:
+    def _init_or_load_params(self, spec: ModelSpec, module) -> tuple[Any, str]:
+        path = self._weights_path(spec)
+        if path is None and not self.allow_random_weights:
+            # raise BEFORE paying module.init — the strict failure
+            # path must be near-instant, not a full flax trace
+            looked = (
+                f"{self.models_dir / spec.key}/"
+                f"{{{self.precision},BF16,FP32,FP16}}/weights.msgpack"
+                if self.models_dir else "(no models_dir configured)"
+            )
+            raise MissingWeightsError(
+                f"no weights found for model '{spec.key}' — looked in "
+                f"{looked}. Install weights with `evam-tpu fetch-models` "
+                "(--from-ir / --synthesize-omz / --download), or set "
+                "EVAM_ALLOW_RANDOM_WEIGHTS=1 to explicitly serve "
+                "deterministic random-init weights (benches/tests only)."
+            )
         rng = jax.random.PRNGKey(_seed_for(spec.key))
         params = module.init(rng, _example_input(spec))["params"]
-        path = self._weights_path(spec)
         if path is not None:
             log.info("loading weights for %s from %s", spec.key, path)
             params = serialization.from_bytes(params, path.read_bytes())
+            source = "msgpack"
         else:
-            log.info("no weights on disk for %s — deterministic random init", spec.key)
-        return _cast_params(params, self.dtype)
+            log.warning(
+                "no weights on disk for %s — deterministic random init "
+                "(EVAM_ALLOW_RANDOM_WEIGHTS is set)", spec.key)
+            source = "random"
+        return _cast_params(params, self.dtype), source
 
     def _find_model_proc(self, spec: ModelSpec) -> ModelProc | None:
         if not self.models_dir:

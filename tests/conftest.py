@@ -13,6 +13,10 @@ hence the top-of-conftest placement.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests run hermetically (no egress, no installed weights): opt in to
+# deterministic random-init weights explicitly. Production serving is
+# strict — see tests/test_models.py::test_missing_weights_is_loud.
+os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -86,7 +86,10 @@ class TestRoutes:
     def test_models(self, registry):
         status, data = _request(registry, "GET", "/models")
         assert status == 200
-        assert "object_detection/person_vehicle_bike" in data
+        rows = {f"{d['name']}/{d['version']}": d["weights"] for d in data}
+        assert "object_detection/person_vehicle_bike" in rows
+        # hermetic test env: provenance must say so, not pretend
+        assert rows["object_detection/person_vehicle_bike"] == "random"
 
     def test_healthz_and_metrics(self, registry):
         status, data = _request(registry, "GET", "/healthz")

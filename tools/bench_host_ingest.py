@@ -11,6 +11,9 @@ Prints a small JSON report; the committed numbers live in INGEST.md.
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import json
 import os
 import sys

@@ -13,6 +13,9 @@ tensorflow/tensorboard parsing is available; otherwise inspect with
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import argparse
 import os
 import sys

@@ -55,7 +55,8 @@ def main() -> int:
               "(wrong path, or the battery never ran)", file=sys.stderr)
         return 1
     repo = Path(__file__).resolve().parent.parent
-    dest = repo / "BENCH_SERVE_r03.json"
+    dest = repo / (sys.argv[2] if len(sys.argv) > 2
+                   else "BENCH_SERVE_r03.json")
     dest.write_text(json.dumps(folded, indent=1) + "\n")
     print("\n".join(lines))
     print(f"\n[folded {len(folded)} entries -> {dest}]", file=sys.stderr)

@@ -10,6 +10,9 @@ Variants of profile_step.py's P3 program on the real chip:
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import os
 import sys
 import time

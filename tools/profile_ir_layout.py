@@ -11,6 +11,9 @@ scale, batch 32 at 512².
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import os
 import sys
 import tempfile

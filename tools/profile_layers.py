@@ -10,6 +10,9 @@ rewrite (evam_tpu/ops/depthwise.py) has a direct hardware number.
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import os
 import sys
 import time

@@ -10,6 +10,9 @@ between consecutive rows. Produces the PROFILE.md table.
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import os
 import sys
 import time

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-4 battery: the round-3 measurement debt (serve-path TPU bench,
+# 40 ms budget, verify_blocking, NHWC gap) plus round-4 additions
+# (accuracy-harness on device). Run the moment the axon tunnel answers.
+# Arm with:  bash tools/tpu_watch.sh tools/tpu_battery_r4.sh /tmp/tpu_battery_r4
+set -u
+OUT=${1:-/tmp/tpu_battery_r4}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+FAILED=0
+run() {
+    name=$1; shift
+    echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+    timeout 1200 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+    local rc=$?
+    echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    # fold after EVERY entry: if the round (or the tunnel) dies
+    # mid-battery, whatever already ran is in the repo working tree
+    python tools/fold_battery2.py "$OUT" BENCH_SERVE_r04.json \
+        > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
+    return $rc
+}
+
+# 0. cheapest headline number FIRST (memory: measure the headline
+#    before anything that can wedge the tunnel)
+run default python bench.py --seconds 12
+
+# 1. THE round-3/4 artifact: the real serving path on the TPU
+#    (source -> runner -> BatchEngine -> track -> classify -> meta ->
+#    publish), device-synth ingest, 64 streams.
+run serve python bench.py --config serve --streams 64 --seconds 24 --batch 256
+run serve_b128 python bench.py --config serve --streams 64 --seconds 16 --batch 128
+run serve_file_32 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
+
+# 2. 40 ms p99 sweep for the record (sla_met=false through the 66 ms
+#    tunnel floor is an honest artifact)
+run sweep40 python bench.py --sweep --seconds 40 --p99-target-ms 40
+
+# 3. re-measured action/audio with fixed metric definitions, AFTER
+#    establishing whether block_until_ready even blocks for small
+#    programs on this backend (the r2 inconsistency suspect)
+run blocking python tools/verify_blocking.py
+run action python bench.py --config action --seconds 8
+run audio python bench.py --config audio --seconds 8
+
+# 4. NHWC layout pass: IR vs zoo gap
+run ir_layout python tools/profile_ir_layout.py
+
+# 5. IR-backed end-to-end serve (synthesized OMZ models + NHWC pass)
+IRDIR=$OUT/omz_models
+if [ ! -d "$IRDIR" ]; then
+    timeout 900 python -m evam_tpu.cli.main fetch-models \
+        --synthesize-omz all --topology manifest --output "$IRDIR" \
+        >"$OUT/fetch.log" 2>&1 || true
+fi
+run detect_ir python bench.py --config detect --models-dir "$IRDIR" --seconds 8
+run serve_ir python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR"
+
+# 6. on-device step times at serving batches (latency budget terms)
+run budget python tools/profile_budget.py
+
+# 7. round-4: accuracy harness forward pass on the real chip (same
+#    fitted weights as the CPU test; proves device numerics)
+if [ -e tools/accuracy_device.py ]; then
+    run accuracy python tools/accuracy_device.py
+fi
+
+# 8. host-ingest point (tunnel-bound here; recorded for completeness)
+run host python bench.py --ingest host --batch 8 --depth 2 --seconds 6
+
+echo "battery r4 complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
+exit $((FAILED > 0))

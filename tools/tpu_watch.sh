@@ -7,6 +7,7 @@ set -u
 BATTERY=${1:?battery script}
 OUT=${2:?output dir}
 MAX_WAIT_S=${3:-28800}
+DEST=${4:-BENCH_SERVE_r03.json}
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"
 start=$(date +%s)
@@ -28,8 +29,8 @@ EOF
         # fold results into the repo immediately: if the round ends
         # before a human/agent returns, the driver's end-of-round
         # commit still captures BENCH_SERVE_r03.json
-        python tools/fold_battery2.py "$OUT" > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
-        echo "$(date -Is) battery rc=$rc; folded -> BENCH_SERVE_r03.json" >> "$OUT/watch.log"
+        python tools/fold_battery2.py "$OUT" "$DEST" > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
+        echo "$(date -Is) battery rc=$rc; folded -> $DEST" >> "$OUT/watch.log"
         exit $rc
     fi
     echo "$(date -Is) probe failed; retrying in 180s" >> "$OUT/watch.log"

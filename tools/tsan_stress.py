@@ -14,6 +14,9 @@ Run: ``python tools/tsan_stress.py`` (needs g++; ~20 s).
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import ctypes
 import os
 import subprocess

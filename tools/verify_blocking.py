@@ -22,6 +22,9 @@ encoder (b256) and the audio net (b256).
 
 from __future__ import annotations
 
+import os as _os
+_os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")  # hermetic profiling tool
+
 import json
 import sys
 import time
