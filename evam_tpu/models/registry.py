@@ -547,7 +547,13 @@ class ModelRegistry:
         served by ``GET /models`` so an operator can see whether a
         model would serve real weights ("msgpack"/"ir-bin"), refuse to
         load ("absent"), or fall back to random init ("random",
-        only when EVAM_ALLOW_RANDOM_WEIGHTS allows it)."""
+        only when EVAM_ALLOW_RANDOM_WEIGHTS allows it).
+
+        Caveat: for a not-yet-loaded IR, "ir-bin+override" means an
+        adjacent weights.msgpack *exists*; if it turns out not to be an
+        IR weight dict, _load_ir keeps the .bin tensors and the row
+        corrects itself to "ir-bin" once the model is cached (checking
+        the msgpack here would mean loading the whole IR)."""
         out = []
         for key in self.keys():
             alias, _, version = key.rpartition("/")

@@ -25,6 +25,29 @@ from evam_tpu.stages.context import FrameContext
 
 log = get_logger("stages.udf")
 
+#: The reference's container layout mounts its stock extensions at
+#: /home/pipeline-server/extensions/** (e.g. pipelines/object_tracking/
+#: object_line_crossing/pipeline.json:7,34-55). An unmodified reference
+#: pipeline.json therefore names paths that only exist in that image;
+#: map their stems onto the built-in counterparts so those files run
+#: here verbatim. Stems differing from ours are listed explicitly.
+_REFERENCE_EXT_PREFIX = "/home/pipeline-server/extensions/"
+_REFERENCE_EXT_ALIASES = {"gva_event_convert": "event_convert"}
+
+
+def _resolve_reference_extension(path: str):
+    from pathlib import Path
+
+    stem = Path(path).stem
+    name = _REFERENCE_EXT_ALIASES.get(stem, stem)
+    try:
+        return importlib.import_module(f"evam_tpu.extensions.{name}")
+    except ImportError:
+        raise ImportError(
+            f"reference extension path {path!r} has no built-in "
+            f"counterpart evam_tpu.extensions.{name}"
+        ) from None
+
 
 class UdfStage(Stage):
     def __init__(self, name: str, properties: dict):
@@ -32,7 +55,14 @@ class UdfStage(Stage):
         module_name = properties.get("module")
         if not module_name:
             raise ValueError(f"udf stage '{name}' needs a 'module' property")
-        if module_name.endswith(".py"):
+        from pathlib import Path as _Path
+
+        if (module_name.startswith(_REFERENCE_EXT_PREFIX)
+                and not _Path(module_name).exists()):
+            # a real file at that path (mounted, as in the reference
+            # container) always wins over the built-in mapping
+            module = _resolve_reference_extension(module_name)
+        elif module_name.endswith(".py"):
             # path form, as the reference uses absolute .py paths;
             # import under a unique name so same-stem files in
             # different directories never collide.

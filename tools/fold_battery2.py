@@ -16,6 +16,7 @@ import sys
 from pathlib import Path
 
 ENTRIES = [
+    ("default", "headline: raw engine loop, default config"),
     ("serve", "serving path, 64 streams, b256, seed ingest"),
     ("serve_b128", "serving path, 64 streams, b128"),
     ("serve_file_32", "serving path, 32 streams, file publish"),
@@ -27,6 +28,7 @@ ENTRIES = [
     ("audio", "audio streams (window-rate/5 metric)"),
     ("ir_layout", "NCHW-vs-NHWC IR executor gap"),
     ("budget", "on-device step time + 40ms budget table"),
+    ("accuracy", "accuracy harness forward on the real chip"),
     ("host", "host-ingest point (tunnel-bound here)"),
 ]
 
@@ -35,7 +37,7 @@ def main() -> int:
     out_dir = Path(sys.argv[1] if len(sys.argv) > 1
                    else "/tmp/tpu_battery2_r3")
     folded: dict[str, object] = {}
-    lines = ["", "## Round 3 battery part 2 (real v5e, post-recovery)", ""]
+    lines = ["", f"## Battery fold: {out_dir.name} (real chip)", ""]
     for name, desc in ENTRIES:
         path = out_dir / f"{name}.json"
         if not path.exists():

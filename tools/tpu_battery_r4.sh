@@ -2,7 +2,8 @@
 # Round-4 battery: the round-3 measurement debt (serve-path TPU bench,
 # 40 ms budget, verify_blocking, NHWC gap) plus round-4 additions
 # (accuracy-harness on device). Run the moment the axon tunnel answers.
-# Arm with:  bash tools/tpu_watch.sh tools/tpu_battery_r4.sh /tmp/tpu_battery_r4
+# Arm with:
+#   bash tools/tpu_watch.sh tools/tpu_battery_r4.sh /tmp/tpu_battery_r4 43200 BENCH_SERVE_r04.json
 set -u
 OUT=${1:-/tmp/tpu_battery_r4}
 mkdir -p "$OUT"

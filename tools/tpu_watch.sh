@@ -2,12 +2,14 @@
 # Poll the axon tunnel with a hard-timeout subprocess probe; the moment
 # it answers, fire the given battery script. Front-loads TPU work after
 # a wedge without burning attention on manual polling.
-#   tools/tpu_watch.sh tools/tpu_battery2_r3.sh /tmp/tpu_battery2_r3
+#   tools/tpu_watch.sh tools/tpu_battery_r4.sh /tmp/tpu_battery_r4 43200 BENCH_SERVE_r04.json
 set -u
 BATTERY=${1:?battery script}
 OUT=${2:?output dir}
 MAX_WAIT_S=${3:-28800}
-DEST=${4:-BENCH_SERVE_r03.json}
+# no default: a stale default here would clobber a PRIOR round's
+# committed artifact with this round's fold
+DEST=${4:?dest artifact filename (e.g. BENCH_SERVE_r04.json)}
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"
 start=$(date +%s)
