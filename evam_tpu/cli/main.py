@@ -37,6 +37,30 @@ def cmd_list(args) -> int:
 
 
 def cmd_fetch_models(args) -> int:
+    modes = [m for m, on in [("--download", args.download),
+                             ("--from-ir", bool(args.from_ir)),
+                             ("--synthesize-omz", bool(args.synthesize_omz))]
+             if on]
+    if len(modes) > 1:
+        print(f"fetch-models: {' and '.join(modes)} are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+    if args.download:
+        from evam_tpu.models import download as dl
+
+        try:
+            report = dl.download_models(
+                model_list=args.model_list, output=args.output,
+                base_url=args.base_url or dl.DEFAULT_BASE_URL,
+                proc_base_url=args.proc_base_url or dl.DEFAULT_PROC_BASE_URL,
+                force=args.force,
+            )
+        except dl.DownloadError as exc:
+            print(f"fetch-models --download: {exc}", file=sys.stderr)
+            return 1
+        print(f"installed={report.installed} skipped={report.skipped} "
+              f"failed={report.failed}")
+        return 0 if report.ok else 1
     if args.synthesize_omz:
         from evam_tpu.models.fetch import synthesize_omz
 
@@ -83,6 +107,18 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--model-list", default="models_list/models.list.yml")
     f.add_argument("--output", default="models")
     f.add_argument("--force", action="store_true")
+    f.add_argument("--download", action="store_true",
+                   help="fetch OpenVINO IR artifacts + model-procs over "
+                        "the network (reference model_downloader "
+                        "counterpart); validates the model list with "
+                        "jsonschema, import-checks every IR before "
+                        "declaring it installed")
+    f.add_argument("--base-url", default=None,
+                   help="--download: IR artifact root "
+                        "({base}/{model}/{precision}/{model}.xml)")
+    f.add_argument("--proc-base-url", default=None,
+                   help="--download: model-proc root "
+                        "({base}/{model}.json)")
     f.add_argument("--from-ir", default=None, metavar="DIR",
                    help="install OpenVINO IR .xml/.bin (file or tree) "
                         "into the serving layout instead of zoo export")

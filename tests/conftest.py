@@ -46,9 +46,18 @@ SLOW_MODULES = {
 
 
 def pytest_collection_modifyitems(config, items):
+    matched = set()
     for item in items:
         if item.path.stem in SLOW_MODULES:
+            matched.add(item.path.stem)
             item.add_marker(pytest.mark.slow)
+    # fail loudly on drift: a renamed/removed module must be pruned
+    # here, not silently promoted into the <90s fast suite. Only check
+    # full-tree collections — a single-file run matches one stem.
+    stems = {item.path.stem for item in items}
+    if len(stems) > 15:
+        stale = SLOW_MODULES - matched
+        assert not stale, f"SLOW_MODULES entries match no test file: {stale}"
 
 
 @pytest.fixture(scope="session")

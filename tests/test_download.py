@@ -1,0 +1,231 @@
+"""Transport-injected model download (round-3 VERDICT item 5).
+
+The reference acquires models over the network
+(``tools/model_downloader/downloader.py:275-296``, shell wrapper
+``model_downloader.sh:24-32``) with jsonschema list validation
+(``downloader.py:60-84``, ``mdt_schema.py:7-34``) and model-proc
+collateral resolution (``downloader.py:93-134``). These tests exercise
+the TPU-native counterpart fully offline by injecting a dict-backed
+transport serving real (synthesized) IR bytes.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from evam_tpu.models.download import (
+    DownloadError,
+    ModelEntry,
+    download_models,
+    validate_model_list,
+)
+
+BASE = "https://mirror.test/models"
+PROCS = "https://mirror.test/procs"
+
+
+class DictTransport:
+    """Serves url→bytes from a dict; records every fetch."""
+
+    def __init__(self, blobs: dict[str, bytes]):
+        self.blobs = blobs
+        self.fetched: list[str] = []
+
+    def fetch(self, url: str) -> bytes:
+        self.fetched.append(url)
+        if url not in self.blobs:
+            raise DownloadError(f"404: {url}")
+        return self.blobs[url]
+
+
+@pytest.fixture(scope="module")
+def ir_bytes(tmp_path_factory):
+    """Real importable IR artifacts (synthesized OMZ-shaped SSD)."""
+    from evam_tpu.models.ir_build import build_crossroad_like_ir
+
+    d = tmp_path_factory.mktemp("irsrc")
+    build_crossroad_like_ir(d, input_size=64, width=8, num_classes=4)
+    return (d / "model.xml").read_bytes(), (d / "model.bin").read_bytes()
+
+
+def _urls(model: str, precision: str = "FP32"):
+    return (f"{BASE}/{model}/{precision}/{model}.xml",
+            f"{BASE}/{model}/{precision}/{model}.bin")
+
+
+def _write_list(tmp_path: Path, text: str) -> Path:
+    p = tmp_path / "models.list.yml"
+    p.write_text(text)
+    return p
+
+
+class TestSchemaValidation:
+    def test_accepts_reference_shapes(self):
+        # both entry forms of mdt_schema.py: bare string and mapping
+        validate_model_list([
+            "mobilenet-ssd",
+            {"model": "person-detection-retail-0013",
+             "alias": "object_detection", "version": 1,
+             "precision": ["FP16", "FP32"],
+             "model-proc": "procs/p.json", "labels": "labels/l.txt"},
+        ])
+
+    def test_rejects_missing_model(self):
+        with pytest.raises(DownloadError, match="schema validation"):
+            validate_model_list([{"alias": "x"}])
+
+    def test_rejects_unknown_property(self):
+        # additionalProperties: False, as in the reference schema
+        with pytest.raises(DownloadError, match="schema validation"):
+            validate_model_list([{"model": "m", "quantize": True}])
+
+    def test_rejects_bad_precision(self):
+        with pytest.raises(DownloadError, match="schema validation"):
+            validate_model_list([{"model": "m", "precision": ["FP64"]}])
+
+    def test_rejects_non_list(self):
+        with pytest.raises(DownloadError, match="schema validation"):
+            validate_model_list({"model": "m"})
+
+
+class TestEntryResolution:
+    def test_defaults(self, tmp_path):
+        e = ModelEntry.resolve("some-model", tmp_path / "l.yml")
+        assert (e.alias, e.version, e.precisions) == (
+            "some-model", "1", ["FP32"])
+        assert e.model_proc is None and e.labels is None
+
+    def test_collateral_relative_to_list(self, tmp_path):
+        # reference downloader.py:195-204: model-proc/labels paths are
+        # resolved against the model list's own directory
+        e = ModelEntry.resolve(
+            {"model": "m", "model-proc": "procs/m.json"},
+            tmp_path / "sub" / "l.yml")
+        assert e.model_proc == tmp_path / "sub" / "procs" / "m.json"
+
+
+class TestDownload:
+    def test_end_to_end_install(self, tmp_path, ir_bytes):
+        xml, bin_ = ir_bytes
+        model = "person-vehicle-bike-detection-crossroad-0078"
+        ux, ub = _urls(model)
+        proc = json.dumps({"json_schema_version": "2.0.0",
+                           "input_preproc": [], "output_postproc": []})
+        t = DictTransport({ux: xml, ub: bin_,
+                           f"{PROCS}/{model}.json": proc.encode()})
+        mlist = _write_list(
+            tmp_path,
+            f"- model: {model}\n  alias: object_detection\n"
+            f"  version: person_vehicle_bike\n  precision: [FP32]\n")
+        report = download_models(mlist, tmp_path / "models", transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.ok and report.installed == [model]
+        root = tmp_path / "models" / "object_detection" / "person_vehicle_bike"
+        assert (root / "FP32" / f"{model}.xml").exists()
+        assert (root / "FP32" / f"{model}.bin").exists()
+        assert (root / f"{model}.json").exists()
+
+    def test_installed_model_serves(self, tmp_path, ir_bytes):
+        """The downloaded layout is the registry's layout: the model
+        must load and forward through the normal serving path."""
+        import jax
+        import numpy as np
+
+        from evam_tpu.models.registry import ModelRegistry
+
+        xml, bin_ = ir_bytes
+        ux, ub = _urls("net")
+        t = DictTransport({ux: xml, ub: bin_})
+        mlist = _write_list(tmp_path, "- model: net\n")
+        out = tmp_path / "models"
+        report = download_models(mlist, out, transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.ok
+        reg = ModelRegistry(models_dir=out, dtype="float32")
+        m = reg.get("net/1")
+        assert m.weight_source == "ir-bin"
+        x = np.zeros((1, 64, 64, 3), np.float32)
+        outp = jax.jit(m.forward)(m.params, x)
+        assert outp["loc"].shape[0] == 1
+
+    def test_corrupt_artifact_fails_entry_and_cleans_up(
+            self, tmp_path, ir_bytes):
+        """A truncated/HTML-error artifact must fail the entry (import
+        check) and leave NO partial install a re-run would skip."""
+        xml, bin_ = ir_bytes
+        good_x, good_b = _urls("good")
+        bad_x, bad_b = _urls("bad")
+        t = DictTransport({
+            good_x: xml, good_b: bin_,
+            bad_x: b"<html>502 Bad Gateway</html>", bad_b: b"",
+        })
+        mlist = _write_list(tmp_path, "- good\n- bad\n")
+        out = tmp_path / "models"
+        report = download_models(mlist, out, transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.installed == ["good"]
+        assert report.failed == ["bad"]
+        assert not (out / "bad").exists(), "partial install must be removed"
+
+    def test_existing_skipped_unless_force(self, tmp_path, ir_bytes):
+        xml, bin_ = ir_bytes
+        ux, ub = _urls("net")
+        t = DictTransport({ux: xml, ub: bin_})
+        mlist = _write_list(tmp_path, "- net\n")
+        out = tmp_path / "models"
+        assert download_models(mlist, out, transport=t, base_url=BASE,
+                               proc_base_url=PROCS).installed == ["net"]
+        r2 = download_models(mlist, out, transport=t, base_url=BASE,
+                             proc_base_url=PROCS)
+        assert r2.skipped == ["net"] and not r2.installed
+        r3 = download_models(mlist, out, transport=t, base_url=BASE,
+                             proc_base_url=PROCS, force=True)
+        assert r3.installed == ["net"]
+
+    def test_missing_remote_proc_is_warning_not_error(
+            self, tmp_path, ir_bytes):
+        # reference downloader.py:135 prints a WARNING and carries on
+        xml, bin_ = ir_bytes
+        ux, ub = _urls("net")
+        t = DictTransport({ux: xml, ub: bin_})
+        mlist = _write_list(tmp_path, "- net\n")
+        report = download_models(mlist, tmp_path / "models", transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.ok
+
+    def test_explicit_missing_collateral_fails(self, tmp_path, ir_bytes):
+        # reference downloader.py:268-271: specified-but-missing
+        # model-proc is an error
+        xml, bin_ = ir_bytes
+        ux, ub = _urls("net")
+        t = DictTransport({ux: xml, ub: bin_})
+        mlist = _write_list(
+            tmp_path, "- model: net\n  model-proc: nope/missing.json\n")
+        report = download_models(mlist, tmp_path / "models", transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.failed == ["net"]
+
+    def test_html_error_page_as_proc_fails_entry(self, tmp_path, ir_bytes):
+        """A mirror answering 200 with an HTML error page for the
+        model-proc must fail the entry at install time, not at first
+        serving request."""
+        xml, bin_ = ir_bytes
+        ux, ub = _urls("net")
+        t = DictTransport({ux: xml, ub: bin_,
+                           f"{PROCS}/net.json": b"<html>502</html>"})
+        mlist = _write_list(tmp_path, "- net\n")
+        out = tmp_path / "models"
+        report = download_models(mlist, out, transport=t,
+                                 base_url=BASE, proc_base_url=PROCS)
+        assert report.failed == ["net"]
+        assert not (out / "net").exists()
+
+    def test_malformed_yaml_raises(self, tmp_path):
+        mlist = _write_list(tmp_path, "{{{not yaml")
+        with pytest.raises(DownloadError):
+            download_models(mlist, tmp_path / "models",
+                            transport=DictTransport({}),
+                            base_url=BASE, proc_base_url=PROCS)
