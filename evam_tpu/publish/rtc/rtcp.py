@@ -7,7 +7,16 @@ sendonly video session; it is SRTCP-protected by the caller with the
 same SRTP context family (RFC 3711 §3.4) — here the sender encrypts
 with its RTCP index and the E-bit, implemented in
 ``SrtcpSender``.
-"""
+
+The receive direction carries the viewer's feedback — Receiver
+Reports (RFC 3550 §6.4.2), transport-layer Generic NACK (RFC 4585
+§6.2.1) and payload-specific PLI / FIR (RFC 4585 §6.3.1, RFC 5104
+§4.3.1) — which drive the session's loss recovery: NACKed packets
+are retransmitted from the send cache, PLI/FIR (or heavy RR loss)
+forces a VP8 keyframe. ``SrtcpReceiver`` unprotects the inbound
+compound, ``parse_feedback`` extracts the actionable bits. The
+reference delegates all of this to webrtcbin's full stack
+(reference docker-compose.yml:51-52)."""
 
 from __future__ import annotations
 
@@ -87,3 +96,143 @@ class SrtcpSender:
             self.auth_key, enc + trailer, hashlib.sha1,
         ).digest()[:srtp.TAG_LEN]
         return enc + trailer + tag
+
+
+class SrtcpReceiver:
+    """SRTCP unprotection for inbound feedback (RFC 3711 §3.4).
+
+    Constructed with the REMOTE side's master key/salt (the browser's
+    DTLS client-write family when we are the DTLS server): verify the
+    80-bit tag over ciphertext+index, then AES-CM decrypt from byte 8
+    using the 31-bit index carried in the trailer.
+    """
+
+    def __init__(self, master_key: bytes, master_salt: bytes):
+        self.cipher_key, self.auth_key, self.salt = srtp.derive_keys(
+            master_key, master_salt,
+            labels=(SrtcpSender.LABEL_RTCP_ENCRYPTION,
+                    SrtcpSender.LABEL_RTCP_AUTH,
+                    SrtcpSender.LABEL_RTCP_SALT),
+        )
+
+    def unprotect(self, pkt: bytes) -> bytes:
+        """SRTCP packet in → plaintext RTCP compound out.
+
+        Raises ``ValueError`` on a bad tag or a malformed packet —
+        callers drop the packet (never act on unauthenticated
+        feedback: a forged NACK burst is a retransmission-amplifier).
+        """
+        if len(pkt) < 8 + 4 + srtp.TAG_LEN:
+            raise ValueError("short SRTCP packet")
+        tag = pkt[-srtp.TAG_LEN:]
+        body = pkt[:-srtp.TAG_LEN]           # ciphertext + E|index
+        calc = hmac.new(
+            self.auth_key, body, hashlib.sha1).digest()[:srtp.TAG_LEN]
+        if not hmac.compare_digest(tag, calc):
+            raise ValueError("SRTCP auth tag mismatch")
+        trailer = struct.unpack("!I", body[-4:])[0]
+        e_bit, index = trailer >> 31, trailer & 0x7FFFFFFF
+        enc = body[:-4]
+        if not e_bit:
+            return enc                        # unencrypted RTCP
+        ssrc = struct.unpack("!I", enc[4:8])[0]
+        iv = srtp.packet_iv(self.salt, ssrc, index)
+        ks = srtp._aes_ctr_keystream(
+            self.cipher_key, iv, len(enc) - 8)
+        return enc[:8] + bytes(b ^ k for b, k in zip(enc[8:], ks))
+
+
+# ------------------------------------------------------------ feedback parse
+
+PT_SR = 200
+PT_RR = 201
+PT_RTPFB = 205   # transport-layer feedback (FMT 1 = Generic NACK)
+PT_PSFB = 206    # payload-specific feedback (FMT 1 = PLI, 4 = FIR)
+
+
+def parse_feedback(compound: bytes) -> dict:
+    """Walk a plaintext RTCP compound and pull out what the sender
+    acts on: ``{"nack": [seq…], "pli": bool, "fir": bool,
+    "fraction_lost": float|None, "highest_seq": int|None}``.
+
+    NACK FCI entries are (PID, BLP) pairs (RFC 4585 §6.2.1): PID is a
+    lost packet, each set bit i of BLP marks PID+i+1 lost too.
+    """
+    out: dict = {"nack": [], "pli": False, "fir": False,
+                 "fraction_lost": None, "highest_seq": None}
+    i = 0
+    while i + 4 <= len(compound):
+        first, pt, length_w = struct.unpack(
+            "!BBH", compound[i:i + 4])
+        if first >> 6 != 2:                  # bad version: stop walking
+            break
+        fmt = first & 0x1F                   # RC for SR/RR, FMT for FB
+        end = i + 4 * (length_w + 1)
+        body = compound[i + 8:end]           # after header + sender-ssrc
+        if pt == PT_RR and fmt >= 1 and len(body) >= 24:
+            # first report block: fraction_lost + ext highest seq
+            out["fraction_lost"] = body[4] / 256.0
+            out["highest_seq"] = struct.unpack("!I", body[8:12])[0]
+        elif pt == PT_RTPFB and fmt == 1:
+            fci = body[4:]                   # skip media-ssrc
+            for j in range(0, len(fci) - 3, 4):
+                pid, blp = struct.unpack("!HH", fci[j:j + 4])
+                out["nack"].append(pid)
+                for bit in range(16):
+                    if blp & (1 << bit):
+                        out["nack"].append((pid + bit + 1) & 0xFFFF)
+        elif pt == PT_PSFB and fmt == 1:
+            out["pli"] = True
+        elif pt == PT_PSFB and fmt == 4:
+            out["fir"] = True
+        i = end
+    return out
+
+
+# ----------------------------------------------- feedback builders (viewer)
+
+def receiver_report(sender_ssrc: int, media_ssrc: int,
+                    fraction_lost: float, cumulative_lost: int,
+                    highest_seq: int) -> bytes:
+    """RR with one report block — the packet a receiving peer sends;
+    here it is the test viewer's way to exercise RR-driven recovery."""
+    fl = min(255, max(0, int(fraction_lost * 256)))
+    return struct.pack(
+        "!BBHI I BBH IIII",
+        0x81, PT_RR, 7, sender_ssrc & 0xFFFFFFFF,
+        media_ssrc & 0xFFFFFFFF,
+        fl, (cumulative_lost >> 16) & 0xFF, cumulative_lost & 0xFFFF,
+        highest_seq & 0xFFFFFFFF,
+        0, 0, 0,  # jitter, LSR, DLSR
+    )
+
+
+def generic_nack(sender_ssrc: int, media_ssrc: int,
+                 seqs: list[int]) -> bytes:
+    """Generic NACK (RFC 4585 §6.2.1) covering ``seqs`` with packed
+    (PID, BLP) FCI entries."""
+    seqs = sorted(set(s & 0xFFFF for s in seqs))
+    fci = b""
+    while seqs:
+        pid = seqs.pop(0)
+        blp = 0
+        rest = []
+        for s in seqs:
+            d = (s - pid) & 0xFFFF
+            if 1 <= d <= 16:
+                blp |= 1 << (d - 1)
+            else:
+                rest.append(s)
+        seqs = rest
+        fci += struct.pack("!HH", pid, blp)
+    hdr = struct.pack(
+        "!BBHII", 0x80 | 1, PT_RTPFB, 2 + len(fci) // 4,
+        sender_ssrc & 0xFFFFFFFF, media_ssrc & 0xFFFFFFFF)
+    return hdr + fci
+
+
+def pli(sender_ssrc: int, media_ssrc: int) -> bytes:
+    """Picture Loss Indication (RFC 4585 §6.3.1) — no FCI."""
+    return struct.pack(
+        "!BBHII", 0x80 | 1, PT_PSFB, 2,
+        sender_ssrc & 0xFFFFFFFF, media_ssrc & 0xFFFFFFFF)

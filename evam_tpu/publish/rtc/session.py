@@ -16,6 +16,7 @@ import re
 import socket
 import threading
 import time
+from collections import OrderedDict
 
 from evam_tpu.obs import get_logger
 from evam_tpu.publish.rtc import dtls, rtcp, srtp, stun, vp8
@@ -65,6 +66,10 @@ def build_answer_sdp(ip: str, port: int, ufrag: str, pwd: str,
         "a=setup:passive",
         "a=rtcp-mux",
         f"a=rtpmap:{PAYLOAD_TYPE} VP8/{CLOCK_RATE}",
+        # advertise loss-recovery feedback so viewers send NACK/PLI
+        f"a=rtcp-fb:{PAYLOAD_TYPE} nack",
+        f"a=rtcp-fb:{PAYLOAD_TYPE} nack pli",
+        f"a=rtcp-fb:{PAYLOAD_TYPE} ccm fir",
         f"a=ssrc:{ssrc} cname:evam-tpu",
         f"a=ssrc:{ssrc} msid:evam video0",
         f"a=candidate:1 1 udp 2130706431 {ip} {port} typ host",
@@ -81,7 +86,8 @@ class RtcSession:
                  bind_ip: str = "0.0.0.0", advertise_ip: str | None = None,
                  cert_dir: str | None = None, fps: float = 15.0,
                  on_dead=None, connect_timeout_s: float = 30.0,
-                 payload_source=None):
+                 payload_source=None, video_mode: str = "key",
+                 gop: int = 12, loss_keyframe_threshold: float = 0.10):
         """``frame_source() -> np.ndarray | None`` supplies BGR frames
         (the publish relay's latest frame) which this session encodes
         itself; ``payload_source() -> bytes | None`` supplies
@@ -90,10 +96,27 @@ class RtcSession:
         stream is viewer-independent). Exactly one must be given.
         ``on_dead(session)`` fires once when the pump thread exits for
         any reason — owners use it to release relay clients and
-        registry slots."""
+        registry slots.
+
+        ``video_mode`` picks the encoder: ``"key"`` (every frame a
+        keyframe — shareable across viewers, lowest latency) or
+        ``"delta"`` (GOP-batched inter frames via ``Vp8GopEncoder``
+        — ~40× lower bitrate, ``gop/fps`` s extra latency; only valid
+        with ``frame_source``). Both modes answer viewer feedback:
+        NACKed packets are retransmitted from the send cache, and
+        PLI/FIR or an RR with ``fraction_lost`` ≥
+        ``loss_keyframe_threshold`` forces the next frame to be a
+        keyframe (a no-op in ``"key"`` mode where every frame
+        already is one)."""
         if (frame_source is None) == (payload_source is None):
             raise ValueError(
                 "give exactly one of frame_source / payload_source")
+        if video_mode not in ("key", "delta"):
+            raise ValueError(f"unknown video_mode {video_mode!r}")
+        if video_mode == "delta" and frame_source is None:
+            raise ValueError(
+                "delta mode needs a frame_source (per-viewer encoder "
+                "state cannot ride a shared payload_source)")
         self.frame_source = frame_source
         self.payload_source = payload_source
         self.width, self.height = width, height
@@ -119,6 +142,21 @@ class RtcSession:
         self._rtp_packets = 0
         self._rtp_octets = 0
         self._last_sr = 0.0
+        self.video_mode = video_mode
+        self.gop = gop
+        self.loss_keyframe_threshold = loss_keyframe_threshold
+        #: seq → protected packet, for NACK retransmission. 512
+        #: packets ≈ several seconds of preview video — beyond that a
+        #: retransmit would arrive too late to matter anyway.
+        self._sent_cache: "OrderedDict[int, bytes]" = OrderedDict()
+        self._srtcp_rx: rtcp.SrtcpReceiver | None = None
+        self._force_key = False
+        self._last_loss_key = 0.0
+        # feedback counters (observable in tests + /metrics)
+        self.nacks_received = 0
+        self.packets_retransmitted = 0
+        self.plis_received = 0
+        self.keyframes_forced = 0
         #: give up (and fire on_dead → relay release) if no viewer
         #: completes ICE+DTLS in this window — an unreachable host
         #: candidate must not pin encode cost forever
@@ -169,8 +207,18 @@ class RtcSession:
                 pass
 
     def _pump(self) -> None:
-        enc = (vp8.Vp8Encoder(self.width, self.height)
-               if self.payload_source is None else None)
+        enc = None
+        delta = None
+        if self.payload_source is None:
+            if self.video_mode == "delta":
+                # GOP batch encode takes seconds — NEVER on the pump
+                # thread (it would stall STUN/DTLS/NACK handling);
+                # a dedicated encoder thread owns the Vp8GopEncoder
+                delta = _DeltaEncoder(
+                    self.width, self.height, self.gop, self._stop)
+                delta.start()
+            else:
+                enc = vp8.Vp8Encoder(self.width, self.height)
         pk = vp8.Vp8Packetizer(self.ssrc, PAYLOAD_TYPE)
         last_dtls_progress = time.monotonic()
         next_frame_t = 0.0
@@ -192,7 +240,10 @@ class RtcSession:
                     elif stun.is_dtls(data):
                         self.dtls.put_datagram(data)
                         last_dtls_progress = time.monotonic()
-                    # else: inbound RTCP (rtcp-mux) — sendonly, ignore
+                    elif (len(data) >= 2 and 192 <= data[1] <= 223
+                          and self._srtcp_rx is not None):
+                        # rtcp-mux (RFC 5761): viewer feedback
+                        self._handle_feedback(data)
 
                 if self.ice.remote_addr is not None and not self.dtls.finished:
                     self.dtls.handshake_step()
@@ -213,9 +264,10 @@ class RtcSession:
                             f"DTLS peer fingerprint mismatch: "
                             f"offer={want[:20]}… peer="
                             f"{(got or 'none')[:20]}…")
-                    key, salt, _rk, _rs = self.dtls.srtp_keys()
+                    key, salt, rk, rs = self.dtls.srtp_keys()
                     self.sender = srtp.SrtpSender(key, salt)
                     self._srtcp = rtcp.SrtcpSender(key, salt)
+                    self._srtcp_rx = rtcp.SrtcpReceiver(rk, rs)
                     self.connected.set()
                     log.info("rtc: media up to %s (%s)",
                              self.ice.remote_addr,
@@ -233,21 +285,37 @@ class RtcSession:
                         and self.ice.remote_addr is not None
                         and now >= next_frame_t):
                     next_frame_t = now + 1.0 / self.fps
-                    if enc is not None:
+                    payload = None
+                    if delta is not None:
+                        if self._force_key:
+                            self._force_key = False
+                            self.keyframes_forced += 1
+                            delta.force_keyframe()
+                        frame = self.frame_source()
+                        if frame is not None:
+                            delta.submit(frame)
+                        payload = delta.next_payload()
+                    elif enc is not None:
+                        self._force_key = False  # every frame is one
                         frame = self.frame_source()
                         if frame is None:
                             continue
                         payload = enc.encode(frame)
                     else:
+                        self._force_key = False
                         payload = self.payload_source()
-                        if payload is None:
-                            continue
+                    if payload is None:
+                        continue
                     ts = (ts0 + int((now - t_start) * CLOCK_RATE)) \
                         & 0xFFFFFFFF
                     for pkt in pk.packetize(payload, ts):
+                        seq = int.from_bytes(pkt[2:4], "big")
+                        protected = self.sender.protect(pkt)
                         self.sock.sendto(
-                            self.sender.protect(pkt),
-                            self.ice.remote_addr)
+                            protected, self.ice.remote_addr)
+                        self._sent_cache[seq] = protected
+                        while len(self._sent_cache) > 512:
+                            self._sent_cache.popitem(last=False)
                         self._rtp_packets += 1
                         self._rtp_octets += len(pkt) - 12
                     self.frames_sent += 1
@@ -263,6 +331,149 @@ class RtcSession:
         finally:
             if enc is not None:
                 enc.close()
+            if delta is not None:
+                delta.close()
+
+    def _handle_feedback(self, data: bytes) -> None:
+        """Unprotect + act on one inbound SRTCP compound. Forged or
+        corrupt packets are dropped (unauthenticated feedback must
+        never drive retransmission — amplification risk)."""
+        try:
+            plain = self._srtcp_rx.unprotect(data)
+        except ValueError:
+            return
+        fb = rtcp.parse_feedback(plain)
+        if fb["nack"]:
+            self.nacks_received += 1
+            for seq in fb["nack"]:
+                pkt = self._sent_cache.get(seq & 0xFFFF)
+                if pkt is not None and self.ice.remote_addr is not None:
+                    # resend the identical protected packet: same SRTP
+                    # index ⇒ same keystream, a plain dup on the wire
+                    self.sock.sendto(pkt, self.ice.remote_addr)
+                    self.packets_retransmitted += 1
+        want_key = fb["pli"] or fb["fir"]
+        if fb["pli"] or fb["fir"]:
+            self.plis_received += 1
+        lost = fb["fraction_lost"]
+        if (not want_key and lost is not None
+                and lost >= self.loss_keyframe_threshold):
+            # heavy reported loss without an explicit PLI: refresh
+            # the picture anyway, at most once per second
+            now = time.monotonic()
+            if now - self._last_loss_key > 1.0:
+                self._last_loss_key = now
+                want_key = True
+        if want_key:
+            self._force_key = True
+
+
+class _DeltaEncoder:
+    """Dedicated encoder thread for delta-mode sessions.
+
+    ``Vp8GopEncoder`` encodes a whole GOP per pass (seconds on small
+    hosts); running it inline would freeze the session pump — no
+    STUN/DTLS answers, no NACK retransmits — for the duration. The
+    pump instead submits frames/force-keyframe commands to this
+    thread and paces finished payloads out one per tick. Ordering is
+    preserved because one thread owns both the command queue and the
+    payload queue; a force command drains stale continuation deltas
+    before the fresh keyframe lands.
+    """
+
+    def __init__(self, width: int, height: int, gop: int, stop_event):
+        import queue as queue_mod
+        import threading as threading_mod
+
+        self.enc = vp8.Vp8GopEncoder(width, height, gop)
+        self._cmds: "queue_mod.Queue" = queue_mod.Queue(maxsize=2 * gop)
+        self._payloads: "queue_mod.Queue" = queue_mod.Queue()
+        self._stop = stop_event
+        #: close() must end the thread even when the pump died
+        #: without the session-level stop event being set
+        self._done = threading_mod.Event()
+        self._thread = threading_mod.Thread(
+            target=self._run, name="vp8-gop-enc", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def submit(self, frame) -> None:
+        try:
+            self._cmds.put_nowait(("frame", frame))
+        except Exception:  # noqa: BLE001 — encoder behind: skip frame
+            pass
+
+    def force_keyframe(self) -> None:
+        try:
+            self._cmds.put_nowait(("force", None))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def next_payload(self):
+        try:
+            return self._payloads.get_nowait()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _run(self) -> None:
+        import queue as queue_mod
+
+        while not (self._stop.is_set() or self._done.is_set()):
+            try:
+                cmd, arg = self._cmds.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            try:
+                if cmd == "force":
+                    # stale continuation deltas are useless to a
+                    # receiver that just reported picture loss
+                    while True:
+                        try:
+                            self._payloads.get_nowait()
+                        except queue_mod.Empty:
+                            break
+                    self.enc.force_keyframe()
+                else:
+                    for p in self.enc.push(arg):
+                        self._payloads.put(p)
+            except Exception as exc:  # noqa: BLE001 — encoder failure
+                log.warning("vp8 gop encoder error: %s", exc)
+
+    def close(self) -> None:
+        self._done.set()
+        self._thread.join(timeout=5)
+        self.enc.close()
+
+
+class RelayBgrSource:
+    """Generation-cursor JPEG→BGR decode over a ``FrameRelay``.
+
+    THE relay-consumption protocol for media sessions — shared by
+    ``SharedVp8Source`` (key mode) and the delta-mode per-viewer
+    ``frame_source`` (publish/webrtc.py) so the timeout and
+    stalled-pipeline resend rules can't diverge. ``frame()`` returns
+    the latest decoded BGR frame (the previous one while the pipeline
+    is stalled, None before the first frame); ``gen`` identifies it.
+    """
+
+    def __init__(self, relay, timeout: float = 0.5):
+        self.relay = relay
+        self.timeout = timeout
+        self.gen = 0
+        self._frame = None
+
+    def frame(self):
+        import cv2
+        import numpy as np
+
+        jpeg, gen = self.relay.next_frame(self.gen, timeout=self.timeout)
+        if jpeg is not None and gen != self.gen:
+            frame = cv2.imdecode(
+                np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
+            if frame is not None:
+                self._frame, self.gen = frame, gen
+        return self._frame
 
 
 class SharedVp8Source:
@@ -276,26 +487,20 @@ class SharedVp8Source:
     def __init__(self, relay, width: int = 640, height: int = 360):
         import threading as _t
 
-        self.relay = relay
+        self.src = RelayBgrSource(relay)
         self.enc = vp8.Vp8Encoder(width, height)
         self._lock = _t.Lock()
-        self._gen = 0
+        self._enc_gen = 0
         self._payload: bytes | None = None
 
     def payload(self) -> bytes | None:
-        import cv2
-        import numpy as np
-
-        jpeg, gen = self.relay.next_frame(self._gen, timeout=0.5)
-        if jpeg is None:
+        frame = self.src.frame()
+        if frame is None:
             return self._payload  # stalled pipeline: resend last
         with self._lock:
-            if gen != self._gen:
-                frame = cv2.imdecode(
-                    np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
-                if frame is not None:
-                    self._payload = self.enc.encode(frame)
-                    self._gen = gen
+            if self.src.gen != self._enc_gen:
+                self._payload = self.enc.encode(frame)
+                self._enc_gen = self.src.gen
         return self._payload
 
     def close(self) -> None:

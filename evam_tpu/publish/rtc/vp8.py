@@ -125,6 +125,79 @@ class Vp8Encoder:
             pass
 
 
+class Vp8GopEncoder:
+    """Delta-frame VP8 via GOP-batched encoding.
+
+    The image's encoder (cv2.VideoWriter → FFmpeg/libvpx) buffers
+    output until ``release()`` (measured: 256 KB AVIO buffer + libvpx
+    lookahead — nothing reaches disk per-frame), so true streaming
+    delta encode isn't reachable through it. Instead frames are
+    collected into a small GOP and encoded in one writer pass,
+    yielding one keyframe + (gop-1) genuine inter frames — ~40×
+    smaller deltas measured at 320×180 — at the cost of ``gop/fps``
+    seconds of added latency. The session paces the returned burst
+    out one payload per frame tick, so the wire stays smooth.
+
+    ``force_keyframe()`` (PLI / heavy RR loss / viewer join) discards
+    the pending GOP — after picture loss the receiver can't use
+    continuation deltas anyway — and encodes the next frame alone,
+    which makes it an immediate keyframe.
+    """
+
+    def __init__(self, width: int, height: int, gop: int = 12):
+        if gop < 1:
+            raise ValueError("gop must be >= 1")
+        self.width, self.height = width, height
+        self.gop = gop
+        self._buf: list[np.ndarray] = []
+        self._force_key = False
+        self._enc = Vp8Encoder(width, height)
+
+    def force_keyframe(self) -> None:
+        self._force_key = True
+
+    def push(self, frame_bgr: np.ndarray) -> list[bytes]:
+        """Add one frame; returns [] while the GOP fills, then the
+        whole GOP's payloads (payload[0] is the keyframe)."""
+        if self._force_key:
+            self._force_key = False
+            self._buf = [frame_bgr]      # 1-frame GOP ⇒ keyframe now
+            return self._encode_buf()
+        self._buf.append(frame_bgr)
+        if len(self._buf) < self.gop:
+            return []
+        return self._encode_buf()
+
+    def flush(self) -> list[bytes]:
+        """Encode whatever is buffered (end-of-stream)."""
+        return self._encode_buf() if self._buf else []
+
+    def _encode_buf(self) -> list[bytes]:
+        import cv2
+
+        frames, self._buf = self._buf, []
+        wr = cv2.VideoWriter(
+            self._enc._path, cv2.VideoWriter_fourcc(*"VP80"), 30,
+            (self.width, self.height))
+        if not wr.isOpened():
+            raise RuntimeError("VP8 encoder unavailable in this build")
+        for f in frames:
+            if f.shape[1] != self.width or f.shape[0] != self.height:
+                f = cv2.resize(f, (self.width, self.height))
+            wr.write(f)
+        wr.release()
+        with open(self._enc._path, "rb") as fh:
+            blocks = simple_blocks(fh.read())
+        if len(blocks) != len(frames):
+            raise RuntimeError(
+                f"encoder returned {len(blocks)} blocks "
+                f"for {len(frames)} frames")
+        return blocks
+
+    def close(self) -> None:
+        self._enc.close()
+
+
 # -------------------------------------------------------------- packetizer
 
 

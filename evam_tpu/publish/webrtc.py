@@ -37,10 +37,15 @@ log = get_logger("publish.webrtc")
 
 
 class WebRtcSignaler:
-    def __init__(self, server_url: str, stream: str, relay: FrameRelay):
+    def __init__(self, server_url: str, stream: str, relay: FrameRelay,
+                 video_mode: str = "key"):
+        """``video_mode``: "key" (shared keyframe-only encoder) or
+        "delta" (per-viewer GOP delta sessions) — plumbed from
+        ``Settings.webrtc_video_mode`` (EVAM_WEBRTC_VIDEO_MODE)."""
         self.server_url = server_url
         self.stream = stream
         self.relay = relay
+        self.video_mode = video_mode
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         #: peer id -> live RtcSession (SDP-negotiated viewers);
@@ -82,6 +87,7 @@ class WebRtcSignaler:
         """Create a media session for one viewer; returns answer SDP."""
         try:
             from evam_tpu.publish.rtc.session import (
+                RelayBgrSource,
                 RtcSession,
                 SharedVp8Source,
             )
@@ -92,14 +98,26 @@ class WebRtcSignaler:
         # its previous session, keeping the relay client count balanced
         self._drop_session(peer)
         try:
-            if self._vp8 is None:
-                # one encoder for every viewer of this stream (the
-                # keyframe-only payload is viewer-independent)
-                self._vp8 = SharedVp8Source(self.relay)
-            sess = RtcSession(
-                payload_source=self._vp8.payload,
-                on_dead=lambda s, _p=peer: self._on_session_dead(_p, s),
-            )
+            if self.video_mode == "delta":
+                # per-viewer GOP encoder (delta frames need private
+                # encoder state); ~40× lower bitrate per viewer at
+                # gop/fps extra latency
+                sess = RtcSession(
+                    frame_source=RelayBgrSource(self.relay).frame,
+                    video_mode="delta",
+                    on_dead=lambda s, _p=peer: self._on_session_dead(
+                        _p, s),
+                )
+            else:
+                if self._vp8 is None:
+                    # one encoder for every viewer of this stream (the
+                    # keyframe-only payload is viewer-independent)
+                    self._vp8 = SharedVp8Source(self.relay)
+                sess = RtcSession(
+                    payload_source=self._vp8.payload,
+                    on_dead=lambda s, _p=peer: self._on_session_dead(
+                        _p, s),
+                )
             answer = sess.answer(offer_sdp)
             with self._sessions_lock:
                 self.relay.add_client()  # producers keep encoding

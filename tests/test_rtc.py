@@ -652,3 +652,338 @@ class TestIceLite:
         ).build(integrity_key=None)
         assert ice.handle(req, ("203.0.113.9", 4444)) is None
         assert ice.remote_addr is None and not ice.nominated
+
+
+class TestRtcpFeedback:
+    """Receive-direction RTCP: SRTCP unprotect + RR/NACK/PLI parsing
+    (RFC 4585/5104) — the session's loss-recovery inputs."""
+
+    def test_nack_builder_parse_roundtrip(self):
+        from evam_tpu.publish.rtc import rtcp
+
+        # 3 seqs within one BLP window + 1 far away -> 2 FCI entries
+        pkt = rtcp.generic_nack(1, 2, [100, 101, 113, 400])
+        fb = rtcp.parse_feedback(pkt)
+        assert sorted(fb["nack"]) == [100, 101, 113, 400]
+        assert not fb["pli"] and not fb["fir"]
+
+    def test_nack_seq_wraparound(self):
+        from evam_tpu.publish.rtc import rtcp
+
+        pkt = rtcp.generic_nack(1, 2, [65534, 65535, 0])
+        fb = rtcp.parse_feedback(pkt)
+        assert sorted(fb["nack"]) == [0, 65534, 65535]
+
+    def test_pli_and_rr_parse(self):
+        from evam_tpu.publish.rtc import rtcp
+
+        compound = (
+            rtcp.receiver_report(1, 2, fraction_lost=0.25,
+                                 cumulative_lost=7, highest_seq=5000)
+            + rtcp.pli(1, 2))
+        fb = rtcp.parse_feedback(compound)
+        assert fb["pli"]
+        assert abs(fb["fraction_lost"] - 0.25) < 1 / 256
+        assert fb["highest_seq"] == 5000
+
+    def test_srtcp_receiver_roundtrip_and_tamper(self):
+        import pytest
+
+        from evam_tpu.publish.rtc import rtcp
+
+        key, salt = b"K" * 16, b"S" * 14
+        tx = rtcp.SrtcpSender(key, salt)
+        rx = rtcp.SrtcpReceiver(key, salt)
+        plain = rtcp.generic_nack(0xAA, 0xBB, [42])
+        assert rx.unprotect(tx.protect(plain)) == plain
+        evil = bytearray(tx.protect(plain))
+        evil[10] ^= 0x01
+        with pytest.raises(ValueError):
+            rx.unprotect(bytes(evil))
+
+
+class TestVp8Gop:
+    """GOP-batched delta encoding: real inter frames between periodic
+    keyframes, immediate keyframe on force (PLI path)."""
+
+    @staticmethod
+    def _frames(n, w=320, h=180):
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        out = []
+        for i in range(n):
+            f = base.copy()
+            f[:, : 10 + 4 * i] = (37 * i) % 255
+            out.append(f)
+        return out
+
+    def test_gop_emits_keyframe_then_deltas(self):
+        from evam_tpu.publish.rtc import vp8
+
+        enc = vp8.Vp8GopEncoder(320, 180, gop=5)
+        frames = self._frames(5)
+        bursts = [enc.push(f) for f in frames]
+        assert all(b == [] for b in bursts[:-1])
+        payloads = bursts[-1]
+        assert len(payloads) == 5
+        flags = [vp8.parse_vp8_header(p)["keyframe"] for p in payloads]
+        assert flags == [True, False, False, False, False]
+        # the whole point: deltas are far smaller than the keyframe
+        assert max(len(p) for p in payloads[1:]) \
+            < len(payloads[0]) / 4
+        enc.close()
+
+    def test_force_keyframe_flushes_immediately(self):
+        from evam_tpu.publish.rtc import vp8
+
+        enc = vp8.Vp8GopEncoder(320, 180, gop=10)
+        frames = self._frames(4)
+        assert enc.push(frames[0]) == []
+        assert enc.push(frames[1]) == []
+        enc.force_keyframe()
+        burst = enc.push(frames[2])
+        assert len(burst) == 1
+        assert vp8.parse_vp8_header(burst[0])["keyframe"]
+        # GOP restarts cleanly after the forced keyframe
+        assert enc.push(frames[3]) == []
+        tail = enc.flush()
+        assert len(tail) == 1 \
+            and vp8.parse_vp8_header(tail[0])["keyframe"]
+        enc.close()
+
+
+class _Viewer:
+    """Software viewer (browser role) for loss-recovery tests: ICE +
+    DTLS + SRTP decrypt, with the feedback sender a browser has."""
+
+    def __init__(self, tmp_path, sess):
+        import socket
+
+        from evam_tpu.publish.rtc import dtls, rtcp
+        from evam_tpu.publish.rtc.session import parse_remote_sdp
+
+        self.sess = sess
+        cert, key, self.fp = dtls.generate_certificate(
+            str(tmp_path / "viewer"))
+        self.cli = dtls.DtlsEndpoint(cert, key, server=False)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.settimeout(0.2)
+        self.target = ("127.0.0.1", sess.port)
+        offer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
+            "m=video 9 UDP/TLS/RTP/SAVPF 96",
+            "a=mid:0", "a=ice-ufrag:vu", "a=ice-pwd:" + "p" * 22,
+            f"a=fingerprint:sha-256 {self.fp}", "a=setup:active",
+        ])
+        self.answer = sess.answer(offer)
+        self.ans = parse_remote_sdp(self.answer)
+        self.media: list[bytes] = []
+        self.srtcp_tx: rtcp.SrtcpSender | None = None
+        self.ssrc = 0xDEADBEEF
+
+    def connect(self, timeout=20.0):
+        import time
+
+        from evam_tpu.publish.rtc import rtcp, stun as stun_m
+
+        check = stun_m.StunMessage(
+            stun_m.BINDING_REQUEST, b"\x22" * 12,
+            [(stun_m.ATTR_USERNAME,
+              f"{self.ans['ufrag']}:vu".encode()),
+             (stun_m.ATTR_USE_CANDIDATE, b"")],
+        ).build(integrity_key=self.ans["pwd"].encode())
+        self.sock.sendto(check, self.target)
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self.cli.finished:
+            self.cli.handshake_step()
+            for d in self.cli.take_datagrams():
+                self.sock.sendto(d, self.target)
+            self._recv_once()
+        assert self.cli.finished, "viewer DTLS handshake failed"
+        lk, ls, rk, rs = self.cli.srtp_keys()
+        self.srtcp_tx = rtcp.SrtcpSender(lk, ls)
+        from evam_tpu.publish.rtc import srtp
+        self._ck, self._ak, self._ss = srtp.derive_keys(rk, rs)
+
+    def _recv_once(self):
+        import socket
+
+        from evam_tpu.publish.rtc import stun as stun_m
+
+        try:
+            data, _ = self.sock.recvfrom(4096)
+        except socket.timeout:
+            return None
+        if stun_m.is_stun(data):
+            return None
+        if stun_m.is_dtls(data):
+            self.cli.put_datagram(data)
+            return None
+        if 192 <= data[1] <= 223:
+            return None
+        self.media.append(data)
+        return data
+
+    def recv_media(self, seconds):
+        import time
+
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            self._recv_once()
+
+    def decrypt(self, pkt):
+        import hashlib
+        import hmac as hmac_mod
+        import struct as st
+
+        from evam_tpu.publish.rtc import srtp
+
+        body, tag = pkt[:-srtp.TAG_LEN], pkt[-srtp.TAG_LEN:]
+        calc = hmac_mod.new(
+            self._ak, body + st.pack("!I", 0), hashlib.sha1
+        ).digest()[:srtp.TAG_LEN]
+        assert hmac_mod.compare_digest(tag, calc)
+        seq = st.unpack("!H", pkt[2:4])[0]
+        ssrc = st.unpack("!I", pkt[8:12])[0]
+        iv = srtp.packet_iv(self._ss, ssrc, seq)
+        ks = srtp._aes_ctr_keystream(self._ck, iv, len(body) - 12)
+        return body[:12] + bytes(
+            b ^ k for b, k in zip(body[12:], ks))
+
+    def frames(self):
+        """Group decrypted packets by RTP timestamp -> VP8 payloads."""
+        import struct as st
+
+        from evam_tpu.publish.rtc import vp8
+
+        by_ts: dict = {}
+        for pkt in self.media:
+            ts = st.unpack("!I", pkt[4:8])[0]
+            by_ts.setdefault(ts, []).append(pkt)
+        out = []
+        for ts in sorted(by_ts):
+            pkts = sorted(
+                by_ts[ts],
+                key=lambda p: st.unpack("!H", p[2:4])[0])
+            # drop dup retransmissions before reassembly
+            seen, uniq = set(), []
+            for p in pkts:
+                s = st.unpack("!H", p[2:4])[0]
+                if s not in seen:
+                    seen.add(s)
+                    uniq.append(p)
+            if not uniq[-1][1] & 0x80:
+                continue  # tail not seen; incomplete frame
+            try:
+                out.append(vp8.depacketize(
+                    [self.decrypt(p) for p in uniq]))
+            except ValueError:
+                continue
+        return out
+
+    def send_feedback(self, rtcp_plain):
+        self.sock.sendto(
+            self.srtcp_tx.protect(rtcp_plain), self.target)
+
+    def seqs(self):
+        import struct as st
+
+        return [st.unpack("!H", p[2:4])[0] for p in self.media]
+
+    def close(self):
+        self.cli.close()
+        self.sock.close()
+
+
+class TestLossRecovery:
+    """VERDICT r3 #7: a dropped packet triggers NACK retransmission
+    and PLI forces a keyframe; the software viewer resyncs."""
+
+    def test_nack_retransmit_and_pli_keyframe(self, tmp_path):
+        import time
+
+        from evam_tpu.publish.rtc import rtcp, vp8
+        from evam_tpu.publish.rtc.session import RtcSession
+
+        state = {"i": 0}
+
+        def frame_source():
+            import numpy as np
+
+            f = np.zeros((180, 320, 3), np.uint8)
+            x = (state["i"] * 7) % 280
+            f[40:140, x:x + 40] = (0, 255, 0)
+            state["i"] += 1
+            return f
+
+        sess = RtcSession(
+            frame_source, width=320, height=180,
+            bind_ip="127.0.0.1", advertise_ip="127.0.0.1",
+            cert_dir=str(tmp_path), fps=30.0,
+            video_mode="delta", gop=100)  # 1 natural keyframe only
+        assert "a=rtcp-fb:96 nack pli" in sess.answer(
+            "\r\n".join([
+                "v=0", "a=mid:0", "a=ice-ufrag:x", "a=ice-pwd:y",
+                "a=fingerprint:sha-256 AA", "a=setup:active"]))
+        viewer = _Viewer(tmp_path, sess)
+        sess.start()
+        try:
+            viewer.connect()
+            # gop=100 at 30fps: first payload only after GOP fill
+            # (100/30 ≈ 3.4 s) + the 100-frame batch encode (1-vCPU:
+            # seconds) — wait generously, then drain a bit more
+            deadline = time.time() + 20
+            while time.time() < deadline and not viewer.media:
+                viewer._recv_once()
+            viewer.recv_media(2.0)
+            assert viewer.media, "no media arrived"
+
+            # --- NACK: pretend we lost a packet we actually saw
+            lost_seq = viewer.seqs()[len(viewer.media) // 2]
+            count_before = viewer.seqs().count(lost_seq)
+            viewer.send_feedback(rtcp.generic_nack(
+                viewer.ssrc, sess.ssrc, [lost_seq]))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                viewer._recv_once()
+                if viewer.seqs().count(lost_seq) > count_before:
+                    break
+            assert viewer.seqs().count(lost_seq) > count_before, \
+                "NACKed packet was not retransmitted"
+            assert sess.nacks_received == 1
+            assert sess.packets_retransmitted >= 1
+
+            # --- PLI: picture loss forces an immediate keyframe
+            keys_before = sum(
+                vp8.parse_vp8_header(f)["keyframe"]
+                for f in viewer.frames())
+            assert keys_before >= 1  # GOP-opening keyframe
+            viewer.send_feedback(rtcp.pli(viewer.ssrc, sess.ssrc))
+            deadline = time.time() + 10
+            resynced = False
+            while time.time() < deadline and not resynced:
+                viewer._recv_once()
+                keys = sum(
+                    vp8.parse_vp8_header(f)["keyframe"]
+                    for f in viewer.frames())
+                resynced = keys > keys_before
+            assert resynced, "PLI did not produce a new keyframe"
+            assert sess.plis_received >= 1
+            assert sess.keyframes_forced >= 1
+
+            # --- RR loss above threshold also refreshes the picture
+            forced_before = sess.keyframes_forced
+            viewer.send_feedback(rtcp.receiver_report(
+                viewer.ssrc, sess.ssrc, fraction_lost=0.5,
+                cumulative_lost=10,
+                highest_seq=max(viewer.seqs())))
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and sess.keyframes_forced == forced_before):
+                viewer._recv_once()
+            assert sess.keyframes_forced > forced_before, \
+                "heavy RR loss did not force a keyframe"
+        finally:
+            viewer.close()
+            sess.stop()
