@@ -25,6 +25,8 @@ import json
 import os
 from pathlib import Path
 
+from typing import Literal
+
 from pydantic import BaseModel, Field
 
 
@@ -64,6 +66,10 @@ class Settings(BaseModel):
     rtsp_port: int = 8554  # reference docker-compose.yml:45,50
     enable_webrtc: bool = False  # reference docker-compose.yml:51
     webrtc_signaling_server: str = ""  # reference docker-compose.yml:52
+    #: "key" = keyframe-only VP8 (shared encoder, lowest latency);
+    #: "delta" = per-viewer GOP delta encoding (~40x lower bitrate,
+    #: gop/fps extra latency) — see publish/rtc/vp8.py
+    webrtc_video_mode: Literal["key", "delta"] = "key"
     log_level: str = "INFO"  # PY_LOG_LEVEL, reference evas/__main__.py:42
     dev_mode: bool = True  # DEV_MODE, reference evas/__main__.py:36
     profiling_mode: bool = False  # reference eii/docker-compose.yml:43
@@ -71,6 +77,11 @@ class Settings(BaseModel):
     #: comma list of pipelines (name or name/version) or "all" to
     #: build+warm engines before the REST port opens (EVAM_PRELOAD)
     preload: str = ""
+    #: >0 routes file/RTSP decode through a shared DecodePool of this
+    #: many worker threads instead of per-stream inline decode —
+    #: bounds total decode threads at 64-stream scale
+    #: (media/pool.py; VERDICT r3 item 10). 0 = per-stream (default).
+    decode_pool_workers: int = 0
     tpu: TPUSettings = Field(default_factory=TPUSettings)
 
     @classmethod
@@ -91,11 +102,13 @@ class Settings(BaseModel):
             "RTSP_PORT": ("rtsp_port", int),
             "ENABLE_WEBRTC": ("enable_webrtc", _parse_bool),
             "WEBRTC_SIGNALING_SERVER": ("webrtc_signaling_server", str),
+            "EVAM_WEBRTC_VIDEO_MODE": ("webrtc_video_mode", str),
             "PY_LOG_LEVEL": ("log_level", str),
             "DEV_MODE": ("dev_mode", _parse_bool),
             "PROFILING_MODE": ("profiling_mode", _parse_bool),
             "EVAM_STATE_DIR": ("state_dir", str),
             "EVAM_PRELOAD": ("preload", str),
+            "EVAM_DECODE_POOL_WORKERS": ("decode_pool_workers", int),
         }
         for var, (key, conv) in mapping.items():
             if var in env:

@@ -7,6 +7,7 @@ from evam_tpu.media.source import (
     create_source,
 )
 from evam_tpu.media.decode import DecodeWorker
+from evam_tpu.media.pool import DecodePool, PooledStream
 
 __all__ = [
     "AppSource",
@@ -16,4 +17,6 @@ __all__ = [
     "VideoSource",
     "create_source",
     "DecodeWorker",
+    "DecodePool",
+    "PooledStream",
 ]

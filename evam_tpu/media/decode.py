@@ -21,6 +21,24 @@ from evam_tpu.obs import get_logger, metrics
 log = get_logger("media.decode")
 
 
+def drop_oldest_put(q: "queue.Queue", item) -> int:
+    """``put_nowait`` with drop-oldest eviction (live-stream
+    backpressure); returns how many queued items were evicted. Shared
+    by DecodeWorker and the DecodePool so the accounting semantics
+    can't diverge."""
+    dropped = 0
+    while True:
+        try:
+            q.put_nowait(item)
+            return dropped
+        except queue.Full:
+            try:
+                q.get_nowait()
+                dropped += 1
+            except queue.Empty:
+                pass
+
+
 class DecodeWorker:
     """Reads a source on a daemon thread into a bounded queue.
 
@@ -78,19 +96,12 @@ class DecodeWorker:
             self.on_frame(ev)
             return
         if self.drop_when_full:
-            while True:
-                try:
-                    self.queue.put_nowait(ev)
-                    return
-                except queue.Full:
-                    try:
-                        self.queue.get_nowait()
-                        self.frames_dropped += 1
-                        metrics.inc(
-                            "evam_frames_dropped", labels={"stream": self.stream_id}
-                        )
-                    except queue.Empty:
-                        pass
+            dropped = drop_oldest_put(self.queue, ev)
+            if dropped:
+                self.frames_dropped += dropped
+                metrics.inc(
+                    "evam_frames_dropped", dropped,
+                    labels={"stream": self.stream_id})
         else:
             while not self._stop.is_set():
                 try:

@@ -51,6 +51,7 @@ class StreamInstance:
         retry_backoff_s: float = 1.0,
         on_finish: Callable[["StreamInstance"], None] | None = None,
         source: Any | None = None,
+        decode_pool: Any | None = None,
     ):
         self.id = str(uuid.uuid4())
         self.pipeline_name = pipeline_name
@@ -67,6 +68,8 @@ class StreamInstance:
         self._injected_source = source
         if source is not None:
             self.max_retries = 0
+        #: shared DecodePool (registry-owned) or None = decode inline
+        self._decode_pool = decode_pool
 
         self.state = InstanceState.QUEUED
         self.error: str | None = None
@@ -173,12 +176,40 @@ class StreamInstance:
             stages=self.stages,
             source_uri=self.request.get("source", {}).get("uri", ""),
         )
+        src_cfg = self.request.get("source", {})
+        pooled = None
+        # Shared decode pool — ONLY for free-running uri sources
+        # (file/VOD/synthetic replay). Sources whose frames() blocks
+        # between frames would pin a shared worker: realtime replay
+        # sleeps 1/fps per read, live cameras/RTSP block on network
+        # arrival, AppSource blocks on its feeder queue — those keep
+        # the per-stream reader model. The pool's win is bulk decode
+        # compute, which is exactly the free-running case (see
+        # INGEST.md "Decode-pool consolidation").
+        if (self._decode_pool is not None
+                and self._injected_source is None
+                and src_cfg.get("type", "uri") == "uri"
+                and not src_cfg.get("realtime", False)):
+            # restart supervision stays HERE (max_restarts=0 in the
+            # pool → its error surfaces below and the instance retry
+            # path recreates everything); lossless backpressure
+            # matches the inline pull-based semantics
+            pooled = self._decode_pool.add_stream(
+                self.id[:8], lambda: source, max_restarts=0,
+                drop_when_full=False)
+            frames = pooled.frames()
+        else:
+            frames = source.frames()
         try:
-            self._runner.run(source.frames())
+            self._runner.run(frames)
+            if pooled is not None and pooled.error:
+                raise IOError(pooled.error)
         finally:
             # Each attempt owns its source: close it here so retries
             # never leak capture handles (RTSP cameras commonly allow
             # a single connection).
+            if pooled is not None:
+                pooled.close()
             with self._src_lock:
                 source.close()
                 if self._source is source:

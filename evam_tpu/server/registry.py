@@ -55,6 +55,14 @@ class PipelineRegistry:
                 stall_timeout_s=settings.tpu.stall_timeout_s,
             )
         self.hub = hub
+        #: shared decode pool (opt-in, EVAM_DECODE_POOL_WORKERS>0):
+        #: bounds total decode threads across all instances
+        self.decode_pool = None
+        if settings.decode_pool_workers > 0:
+            from evam_tpu.media.pool import DecodePool
+
+            self.decode_pool = DecodePool(
+                workers=settings.decode_pool_workers)
         self.instances: dict[str, StreamInstance] = {}
         self._lock = threading.Lock()
         self._draining = False
@@ -186,6 +194,7 @@ class PipelineRegistry:
             destination=destination,
             on_finish=lambda _inst: self._on_instance_finish(cleanup_fns),
             source=source,
+            decode_pool=self.decode_pool,
         )
         meta_fn = publish_fn or (lambda ctx: destination.publish(ctx.metadata))
         frame_cfg = (request.get("destination") or {}).get("frame") or {}
@@ -209,6 +218,7 @@ class PipelineRegistry:
             signaler = WebRtcSignaler(
                 self.settings.webrtc_signaling_server,
                 relay.path, relay,
+                video_mode=self.settings.webrtc_video_mode,
             )
             signaler.start()
             cleanup_fns.append(signaler.stop)
@@ -293,6 +303,8 @@ class PipelineRegistry:
             inst.stop()
         for inst in instances:
             inst.wait(timeout=5)
+        if self.decode_pool is not None:
+            self.decode_pool.stop()
         for inst in active:
             if inst._thread is not None and inst._thread.is_alive():
                 # wait() timed out: this worker may still assign ids

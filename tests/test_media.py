@@ -147,3 +147,171 @@ def test_audio_synthetic_chunks():
     assert len(events) == 10  # 100ms chunks
     assert events[0].audio.shape == (1600,)
     assert events[0].audio.dtype == np.int16
+
+# ------------------------------------------------------- decode pool
+
+
+def test_pool_multiplexes_streams_in_order():
+    from evam_tpu.media import DecodePool
+
+    pool = DecodePool(workers=2)
+    k = 6
+    streams = [
+        pool.add_stream(
+            f"s{i}",
+            lambda i=i: SyntheticSource(width=32, height=32, count=10),
+            maxsize=32)
+        for i in range(k)
+    ]
+    got = [[ev.seq for ev in s.frames()] for s in streams]
+    # every stream sees its full frame sequence, in order, despite
+    # sharing 2 decode threads across 6 streams
+    for seqs in got:
+        assert seqs == list(range(10))
+    for s in streams:
+        assert s.frames_decoded == 10
+        assert s.error is None
+    pool.stop()
+
+
+def test_pool_bounds_decode_threads():
+    import threading
+
+    from evam_tpu.media import DecodePool
+
+    before = {t.name for t in threading.enumerate()}
+    pool = DecodePool(workers=2)
+    for i in range(8):
+        pool.add_stream(
+            f"t{i}",
+            lambda: SyntheticSource(width=32, height=32, count=5),
+            on_frame=lambda ev: None)
+    new = [t.name for t in threading.enumerate()
+           if t.name not in before and t.name.startswith("decode-pool")]
+    assert len(new) == 2  # 8 streams, exactly 2 decode threads
+    pool.stop()
+
+
+def test_pool_paced_stream_is_rate_limited():
+    from evam_tpu.media import DecodePool
+
+    pool = DecodePool(workers=1)
+    t0 = time.perf_counter()
+    paced = pool.add_stream(
+        "paced", lambda: SyntheticSource(width=32, height=32, count=10),
+        fps=50.0, maxsize=32)
+    frames = list(paced.frames())
+    dt = time.perf_counter() - t0
+    assert len(frames) == 10
+    # 10 frames at 50 fps >= ~0.18s; free-running would take ~ms
+    assert dt >= 0.15
+    pool.stop()
+
+
+def test_pool_restart_supervision_and_permanent_failure():
+    from evam_tpu.media import DecodePool
+
+    calls = {"n": 0}
+
+    class Flaky:
+        def __init__(self):
+            calls["n"] += 1
+            self.fail = calls["n"] == 1
+
+        def frames(self):
+            if self.fail:
+                raise IOError("transient")
+            yield from SyntheticSource(
+                width=32, height=32, count=3).frames()
+
+        def close(self):
+            pass
+
+    pool = DecodePool(workers=1, max_restarts=2, restart_backoff_s=0.01)
+    ps = pool.add_stream("flaky", Flaky)
+    events = list(ps.frames())
+    assert calls["n"] == 2 and len(events) == 3
+    assert ps.error is None
+
+    class Dead:
+        def frames(self):
+            raise IOError("permanent")
+            yield  # pragma: no cover
+
+        def close(self):
+            pass
+
+    ps2 = pool.add_stream("dead", Dead, max_restarts=0)
+    assert list(ps2.frames()) == []
+    assert ps2.error == "permanent"
+    pool.stop()
+
+
+def test_pool_instance_integration(tmp_path):
+    """EVAM_DECODE_POOL_WORKERS routes a REST-started instance's
+    decode through the shared pool — full serve path unchanged."""
+    import json as json_mod
+
+    from evam_tpu.config.settings import Settings
+    from evam_tpu.engine import EngineHub
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+    from evam_tpu.parallel import build_mesh
+    from evam_tpu.server.registry import PipelineRegistry
+
+    small = {k: (64, 64) for k in ZOO_SPECS}
+    small["audio_detection/environment"] = (1, 1600)
+    settings = Settings(
+        pipelines_dir="pipelines", decode_pool_workers=2)
+    registry = ModelRegistry(
+        dtype="float32", input_overrides=small,
+        width_overrides={k: 8 for k in ZOO_SPECS})
+    hub = EngineHub(registry, plan=build_mesh(), max_batch=16,
+                    deadline_ms=4.0)
+    reg = PipelineRegistry(settings, hub=hub)
+    assert reg.decode_pool is not None
+    try:
+        outs = [tmp_path / f"meta{i}.jsonl" for i in range(3)]
+        insts = [
+            reg.start_instance(
+                "object_detection", "person_vehicle_bike",
+                {
+                    "source": {"uri": f"synthetic://64x48@30?count=6&seed={i}",
+                               "type": "uri"},
+                    "destination": {"metadata": {
+                        "type": "file", "path": str(outs[i])}},
+                    "parameters": {"threshold": 0.0},
+                })
+            for i in range(3)
+        ]
+        for inst in insts:
+            inst.wait(timeout=120)
+            assert inst.state.value == "COMPLETED", (
+                inst.state, inst.error)
+        for out in outs:
+            lines = [json_mod.loads(l)
+                     for l in out.read_text().splitlines() if l.strip()]
+            assert len(lines) == 6
+            assert all("objects" in m for m in lines)
+    finally:
+        reg.stop_all()
+
+
+def test_pool_lossless_mode_never_drops():
+    """drop_when_full=False + slow consumer + count >> maxsize: every
+    frame arrives (the failure mode of routing file sources through
+    the pool with live-stream semantics — review r4)."""
+    from evam_tpu.media import DecodePool
+
+    pool = DecodePool(workers=2)
+    ps = pool.add_stream(
+        "lossless",
+        lambda: SyntheticSource(width=32, height=32, count=50),
+        maxsize=4, drop_when_full=False)
+    got = []
+    for ev in ps.frames():
+        got.append(ev.seq)
+        time.sleep(0.005)  # consumer slower than decode
+    assert got == list(range(50))
+    assert ps.frames_dropped == 0
+    assert ps.error is None
+    pool.stop()
