@@ -236,19 +236,28 @@ def run_serve_bench(args) -> dict:
     insts = []
     windows: list[dict] = []
     try:
-        for i in range(args.streams):
-            insts.append(reg.start_instance(name, version, {
-                "source": {
-                    "uri": f"synthetic://{src_w}x{src_h}@30?seed={i}",
-                    "type": "uri",
-                },
-                "destination": {"metadata": dest},
-            }))
-
-        # Engines are created lazily by the first frames; wait for
-        # them to exist and finish bucket warmup so the measurement
-        # window never contains a compile.
+        # Build + warm the pipeline's engines BEFORE any stream
+        # exists: bucket-warmup compiles racing steady-state dispatch
+        # means concurrent compile+execute RPCs on the axon tunnel —
+        # the serve entry that wedged the r4 tunnel (battery log
+        # 03:52→04:06 stall) was exactly that overlap. Preload uses
+        # the instance stage-build path, so streams get cache hits.
         t_warm0 = time.perf_counter()
+        n_pre = reg.preload(args.serve_pipeline)
+        if n_pre < 1:
+            # distinguish a name typo from a real build failure —
+            # preload() swallows build errors as warnings and returns
+            # the successfully-built count either way
+            known = any(
+                n == name and (not version or v == version)
+                for n, v in reg.loader.names())
+            if not known:
+                raise RuntimeError(
+                    f"unknown pipeline {args.serve_pipeline!r} "
+                    "(typo? see `evam-tpu list`)")
+            raise RuntimeError(
+                f"pipeline {args.serve_pipeline!r} failed to build — "
+                "see the 'preload ... failed' warning above")
         while True:
             r = reg.hub.readiness()
             if r["engines"] >= 1 and r["warming"] == 0:
@@ -258,6 +267,15 @@ def run_serve_bench(args) -> dict:
             time.sleep(0.5)
         log(f"[serve] {r['engines']} engines warm after "
             f"{time.perf_counter() - t_warm0:.1f}s")
+
+        for i in range(args.streams):
+            insts.append(reg.start_instance(name, version, {
+                "source": {
+                    "uri": f"synthetic://{src_w}x{src_h}@30?seed={i}",
+                    "type": "uri",
+                },
+                "destination": {"metadata": dest},
+            }))
         time.sleep(3.0)  # reach steady state before the clock starts
 
         def frames_out():

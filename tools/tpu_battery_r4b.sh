@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-4 battery, REORDERED after the first serve attempt wedged the
+# tunnel (04:06 stall log in /tmp/tpu_battery_r4): every quick
+# measurement-debt entry runs BEFORE the serve family, so a serve-
+# induced wedge can no longer take the whole round's record with it.
+# The serve entries now preload+warm engines before streams start
+# (bench.py change) — the prime wedge suspect was bucket-warmup
+# compiles racing steady-state dispatch on the tunnel.
+# Arm with:
+#   bash tools/tpu_watch.sh tools/tpu_battery_r4b.sh /tmp/tpu_battery_r4b 43200 BENCH_SERVE_r04.json
+set -u
+OUT=${1:-/tmp/tpu_battery_r4b}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+FAILED=0
+run() {
+    name=$1; hard_timeout=$2; shift 2
+    echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+    timeout "$hard_timeout" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+    local rc=$?
+    echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    python tools/fold_battery2.py "$OUT" BENCH_SERVE_r04.json \
+        > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
+    return $rc
+}
+
+# after a wedge, re-establish the headline cheaply first
+run default 600 python bench.py --seconds 12
+
+# ---- the small-program debt (r3 item 2): these are minutes, not tens
+run blocking 600 python tools/verify_blocking.py
+run action 600 python bench.py --config action --seconds 8
+run audio 600 python bench.py --config audio --seconds 8
+
+# ---- layout + budget instruments (r3 items 1/weak-2, weak-4)
+run ir_layout 900 python tools/profile_ir_layout.py
+run budget 900 python tools/profile_budget.py
+
+# ---- accuracy harness forward pass on the real chip (r4 item 3)
+if [ -e tools/accuracy_device.py ]; then
+    run accuracy 900 python tools/accuracy_device.py
+fi
+
+# ---- 40 ms p99 sweep (r3 item 1): still pre-serve, raw engine path
+run sweep40 900 python bench.py --sweep --seconds 40 --p99-target-ms 40
+
+# ---- IR-backed detect (models synthesized once, reused)
+IRDIR=$OUT/omz_models
+if [ ! -d "$IRDIR" ]; then
+    timeout 900 python -m evam_tpu.cli.main fetch-models \
+        --synthesize-omz all --topology manifest --output "$IRDIR" \
+        >"$OUT/fetch.log" 2>&1 || true
+fi
+run detect_ir 600 python bench.py --config detect --models-dir "$IRDIR" --seconds 8
+
+# ---- host-ingest point
+run host 600 python bench.py --ingest host --batch 8 --depth 2 --seconds 6
+
+# ---- THE serve family, LAST (r3 item 1). Shorter wrapper timeouts:
+# a wedge here costs <=15 min per entry and nothing upstream.
+run serve 900 python bench.py --config serve --streams 64 --seconds 24 --batch 256
+run serve_b128 700 python bench.py --config serve --streams 64 --seconds 16 --batch 128
+run serve_file_32 700 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
+run serve_ir 700 python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR"
+
+echo "battery r4b complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
+exit $((FAILED > 0))
