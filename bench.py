@@ -215,7 +215,7 @@ def run_serve_bench(args) -> dict:
         registry, plan=build_mesh(), max_batch=args.batch,
         deadline_ms=args.deadline_ms, wire_format=args.wire,
         warmup=True, device_synth=args.serve_ingest == "seed",
-        stall_timeout_s=600.0,
+        stall_timeout_s=args.stall_timeout,
     )
     reg = PipelineRegistry(settings, hub=hub)
     name, _, version = args.serve_pipeline.partition("/")
@@ -242,6 +242,11 @@ def run_serve_bench(args) -> dict:
         # the serve entry that wedged the r4 tunnel (battery log
         # 03:52→04:06 stall) was exactly that overlap. Preload uses
         # the instance stage-build path, so streams get cache hits.
+        # A tunnel wedge during warmup must fail INSIDE the battery's
+        # wrapper timeout with a clean error (the engine stall
+        # watchdog doesn't cover warmup dispatches), so bound the
+        # wait by the operator's stall budget, not a hardcoded 900s.
+        warm_timeout = min(900.0, args.stall_timeout + 120.0)
         t_warm0 = time.perf_counter()
         n_pre = reg.preload(args.serve_pipeline)
         if n_pre < 1:
@@ -262,8 +267,10 @@ def run_serve_bench(args) -> dict:
             r = reg.hub.readiness()
             if r["engines"] >= 1 and r["warming"] == 0:
                 break
-            if time.perf_counter() - t_warm0 > 900:
-                raise TimeoutError(f"engine warmup never settled: {r}")
+            if time.perf_counter() - t_warm0 > warm_timeout:
+                raise TimeoutError(
+                    f"engine warmup never settled in "
+                    f"{warm_timeout:.0f}s: {r}")
             time.sleep(0.5)
         log(f"[serve] {r['engines']} engines warm after "
             f"{time.perf_counter() - t_warm0:.1f}s")
@@ -406,6 +413,11 @@ def main() -> int:
     p.add_argument("--serve-publish", choices=["null", "file", "mqtt"],
                    default="null",
                    help="[serve] metadata destination for every stream")
+    p.add_argument(
+        "--stall-timeout", type=float, default=600.0,
+        help="[serve] engine stall watchdog (s); lower it on a "
+             "wedge-prone tunnel so a hung device call fails the "
+             "entry fast instead of burning the window")
     p.add_argument("--deadline-ms", type=float, default=8.0,
                    help="[serve] engine batch-fill deadline")
     p.add_argument(

@@ -59,7 +59,23 @@ def main() -> int:
     repo = Path(__file__).resolve().parent.parent
     dest = repo / (sys.argv[2] if len(sys.argv) > 2
                    else "BENCH_SERVE_r03.json")
-    dest.write_text(json.dumps(folded, indent=1) + "\n")
+    # MERGE into the existing artifact: a re-armed battery whose first
+    # entry crashes must not clobber an earlier good record (e.g. the
+    # committed headline) — and an unparsed tail never overwrites a
+    # previously parsed entry for the same name.
+    merged: dict[str, object] = {}
+    if dest.exists():
+        try:
+            merged = json.loads(dest.read_text())
+        except json.JSONDecodeError:
+            merged = {}
+    for name, val in folded.items():
+        prior = merged.get(name)
+        if (isinstance(val, dict) and "unparsed" in val
+                and isinstance(prior, dict) and "unparsed" not in prior):
+            continue
+        merged[name] = val
+    dest.write_text(json.dumps(merged, indent=1) + "\n")
     print("\n".join(lines))
     print(f"\n[folded {len(folded)} entries -> {dest}]", file=sys.stderr)
     return 0

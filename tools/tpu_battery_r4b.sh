@@ -49,9 +49,14 @@ run sweep40 900 python bench.py --sweep --seconds 40 --p99-target-ms 40
 # ---- IR-backed detect (models synthesized once, reused)
 IRDIR=$OUT/omz_models
 if [ ! -d "$IRDIR" ]; then
-    timeout 900 python -m evam_tpu.cli.main fetch-models \
-        --synthesize-omz all --topology manifest --output "$IRDIR" \
-        >"$OUT/fetch.log" 2>&1 || true
+    # synthesize into a tmp dir and move atomically: a timeout-killed
+    # partial tree must not satisfy the -d guard on the next re-arm
+    rm -rf "$IRDIR.tmp"
+    if timeout 900 python -m evam_tpu.cli.main fetch-models \
+        --synthesize-omz all --topology manifest --output "$IRDIR.tmp" \
+        >"$OUT/fetch.log" 2>&1; then
+        mv "$IRDIR.tmp" "$IRDIR"
+    fi
 fi
 run detect_ir 600 python bench.py --config detect --models-dir "$IRDIR" --seconds 8
 
@@ -60,10 +65,10 @@ run host 600 python bench.py --ingest host --batch 8 --depth 2 --seconds 6
 
 # ---- THE serve family, LAST (r3 item 1). Shorter wrapper timeouts:
 # a wedge here costs <=15 min per entry and nothing upstream.
-run serve 900 python bench.py --config serve --streams 64 --seconds 24 --batch 256
-run serve_b128 700 python bench.py --config serve --streams 64 --seconds 16 --batch 128
-run serve_file_32 700 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file
-run serve_ir 700 python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR"
+run serve 900 python bench.py --config serve --streams 64 --seconds 24 --batch 256 --stall-timeout 180
+run serve_b128 700 python bench.py --config serve --streams 64 --seconds 16 --batch 128 --stall-timeout 180
+run serve_file_32 700 python bench.py --config serve --streams 32 --seconds 12 --batch 256 --serve-publish file --stall-timeout 180
+run serve_ir 700 python bench.py --config serve --streams 64 --seconds 16 --batch 256 --models-dir "$IRDIR" --stall-timeout 180
 
 echo "battery r4b complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
 exit $((FAILED > 0))
