@@ -244,9 +244,10 @@ def run_serve_bench(args) -> dict:
         # the instance stage-build path, so streams get cache hits.
         # A tunnel wedge during warmup must fail INSIDE the battery's
         # wrapper timeout with a clean error (the engine stall
-        # watchdog doesn't cover warmup dispatches), so bound the
-        # wait by the operator's stall budget, not a hardcoded 900s.
-        warm_timeout = min(900.0, args.stall_timeout + 120.0)
+        # watchdog doesn't cover warmup dispatches), so the wait is
+        # bounded by the operator's stall budget — raising
+        # --stall-timeout raises the warmup allowance with it.
+        warm_timeout = args.stall_timeout + 120.0
         t_warm0 = time.perf_counter()
         n_pre = reg.preload(args.serve_pipeline)
         if n_pre < 1:

@@ -46,18 +46,33 @@ CLASS_STYLES = {
     3: ((30, 30, 210), 1.0),    # bike: square, red
 }
 
+#: BGR value per labels.VEHICLE_COLORS entry — the classifier ground
+#: truth for ``color_attr`` scenes (vehicle inner region is painted
+#: with one of these; the border keeps the vehicle class color).
+ATTR_COLORS_BGR = (
+    (245, 245, 245),  # white
+    (130, 130, 130),  # gray
+    (40, 230, 230),   # yellow
+    (40, 40, 230),    # red
+    (40, 200, 40),    # green
+    (230, 130, 40),   # blue
+    (25, 25, 25),     # black
+)
+
 
 @dataclass
 class Scene:
     frame: np.ndarray          # uint8 BGR [H, W, 3]
     boxes: np.ndarray          # float32 [N, 4] normalized x0 y0 x1 y1
     labels: np.ndarray         # int32 [N] (1..3)
+    attrs: np.ndarray | None = None  # int32 [N] color idx; -1 = n/a
 
 
 def render_scene(
     rng: np.random.Generator,
     hw: tuple[int, int] = (1080, 1920),
     max_objects: int = 3,
+    color_attr: bool = False,
 ) -> Scene:
     """One scene: textured background + 1..max_objects solid shapes.
 
@@ -80,7 +95,7 @@ def render_scene(
         0, 255).astype(np.uint8)
 
     n = int(rng.integers(1, max_objects + 1))
-    boxes, labels = [], []
+    boxes, labels, attrs = [], [], []
     for _ in range(n):
         for _attempt in range(20):
             cls = int(rng.integers(1, 4))
@@ -97,16 +112,28 @@ def render_scene(
                 continue
             xi, yi, xe, ye = (int(x0), int(y0), int(x0 + bw), int(y0 + bh))
             frame[yi:ye, xi:xe] = color
-            # a darker inner band gives each class internal structure
             iy, ix = max((ye - yi) // 4, 1), max((xe - xi) // 4, 1)
-            frame[yi + iy:ye - iy, xi + ix:xe - ix] = tuple(
-                c // 2 for c in color)
+            attr = -1
+            if color_attr and cls == 2:
+                # classification ground truth: vehicle interior takes
+                # one of the 7 VEHICLE_COLORS; the border keeps the
+                # class color so detection stays learnable
+                attr = int(rng.integers(0, len(ATTR_COLORS_BGR)))
+                frame[yi + iy:ye - iy, xi + ix:xe - ix] = \
+                    ATTR_COLORS_BGR[attr]
+            else:
+                # a darker inner band gives each class internal
+                # structure
+                frame[yi + iy:ye - iy, xi + ix:xe - ix] = tuple(
+                    c // 2 for c in color)
             boxes.append(cand)
             labels.append(cls)
+            attrs.append(attr)
             break
     return Scene(frame=frame,
                  boxes=np.stack(boxes).astype(np.float32),
-                 labels=np.asarray(labels, np.int32))
+                 labels=np.asarray(labels, np.int32),
+                 attrs=np.asarray(attrs, np.int32))
 
 
 def _max_iou(box: np.ndarray, others: np.ndarray) -> float:
@@ -167,6 +194,7 @@ def fit_detector(
     batch: int = 8,
     lr: float = 3e-3,
     source_hw: tuple[int, int] = (1080, 1920),
+    color_attr: bool = False,
 ):
     """Fit the zoo SSD to the synthetic scenes on the CPU mesh.
 
@@ -194,10 +222,11 @@ def fit_detector(
     imgs, cls_ts, box_ts = [], [], []
     for i in range(n_scenes):
         if i % 2 == 0:
-            scene = render_scene(rng, hw=(h, w))
+            scene = render_scene(rng, hw=(h, w), color_attr=color_attr)
             img = scene.frame
         else:
-            scene = render_scene(rng, hw=source_hw)
+            scene = render_scene(rng, hw=source_hw,
+                                 color_attr=color_attr)
             img = cv2.resize(scene.frame, (w, h),
                              interpolation=cv2.INTER_AREA)
         cls_t, box_t = match_anchors(anchors_c, scene, pos_iou=0.4)
@@ -212,17 +241,14 @@ def fit_detector(
              n_scenes, anchors.shape[0], n_pos)
 
     pre = model.preprocess
-    mean = np.asarray(pre.mean, np.float32)
-    std = np.asarray(pre.std, np.float32)
     module = model.module
 
     def _model_input(u8):
-        x = u8.astype(jnp.float32)
-        if pre.color_space.upper() == "RGB":
-            x = x[..., ::-1]
-        if not pre.raw_range:
-            x = x / 255.0
-        return (x - mean) / std
+        # the SERVING normalization op, not a copy — training and
+        # serving must share color-space/range/mean-std semantics
+        from evam_tpu.ops.preprocess import preprocess_bgr
+
+        return preprocess_bgr(u8.astype(jnp.float32), pre)
 
     anchors_j = jnp.asarray(anchors)
     variances = model.variances
@@ -277,6 +303,156 @@ def fit_detector(
             history.append(float(loss))
             log.info("fit step %d loss %.4f", step, float(loss))
     return params, history
+
+
+def render_vehicle_crop(
+    rng: np.random.Generator, attr: int,
+    out_hw: tuple[int, int],
+) -> np.ndarray:
+    """One classifier training crop produced by the SERVING crop path.
+
+    Domain-matched training is the point (measured: clean cv2 crops
+    train a net that confuses white/gray once crops arrive through
+    the wire): render the vehicle into a small frame, convert with
+    ``bgr_to_i420_host`` (BT.601 + 2×2 chroma subsampling), then cut
+    the crop with ``crop_rois_i420`` using a box jittered like an
+    IoU≥0.5 detection (shift/scale up to ~30%). The returned uint8
+    crop has exactly the serving path's resize + color statistics.
+    """
+    import jax.numpy as jnp
+
+    from evam_tpu.ops.color import bgr_to_i420_host, crop_rois_i420
+
+    # small host frame (multiple of 2 for i420) with the vehicle
+    # somewhere inside it
+    fh, fw = 96, 128
+    bg = int(rng.integers(96, 160))
+    frame = np.full((fh, fw, 3), bg, np.uint8)
+    bh = int(rng.integers(24, 72))
+    bw = int(rng.integers(40, 110))
+    y0 = int(rng.integers(2, fh - bh - 2))
+    x0 = int(rng.integers(2, fw - bw - 2))
+    frame[y0:y0 + bh, x0:x0 + bw] = CLASS_STYLES[2][0]
+    iy, ix = max(bh // 4, 1), max(bw // 4, 1)
+    frame[y0 + iy:y0 + bh - iy, x0 + ix:x0 + bw - ix] = \
+        ATTR_COLORS_BGR[attr]
+
+    # detection-like jitter on the crop box (±30% shift/scale)
+    jx0 = x0 + rng.uniform(-0.3, 0.3) * bw
+    jy0 = y0 + rng.uniform(-0.3, 0.3) * bh
+    jx1 = x0 + bw + rng.uniform(-0.3, 0.3) * bw
+    jy1 = y0 + bh + rng.uniform(-0.3, 0.3) * bh
+    box = np.asarray([[[
+        max(jx0 / fw, 0.0), max(jy0 / fh, 0.0),
+        min(jx1 / fw, 1.0), min(jy1 / fh, 1.0)]]], np.float32)
+    wire = bgr_to_i420_host(frame)[None]
+    crop = crop_rois_i420(jnp.asarray(wire), jnp.asarray(box), out_hw)
+    return np.asarray(crop[0, 0]).astype(np.uint8)
+
+
+def fit_classifier(
+    model,
+    seed: int = 1,
+    n_crops: int = 512,
+    steps: int = 400,
+    batch: int = 32,
+    lr: float = 3e-3,
+):
+    """Fit the zoo attributes classifier's color head to the attr
+    palette. ``model`` is a LoadedModel for the ``classifier`` spec
+    (heads color/type). The type head is trained to a constant
+    ('car') — scenes render one vehicle shape — so only the color
+    head carries ground truth. Returns ``(params, history)``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    spec = model.spec
+    h, w = spec.input_size
+    rng = np.random.default_rng(seed)
+    attrs = rng.integers(0, len(ATTR_COLORS_BGR), size=n_crops)
+    crops = np.stack([
+        render_vehicle_crop(rng, int(a), (h, w)) for a in attrs
+    ])
+    pre = model.preprocess
+    module = model.module
+
+    def _model_input(u8):
+        from evam_tpu.ops.preprocess import preprocess_bgr
+
+        return preprocess_bgr(u8.astype(jnp.float32), pre)
+
+    def loss_fn(params, u8, y):
+        out = module.apply({"params": params}, _model_input(u8))
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            out["color"].astype(jnp.float32), y).mean()
+        ce_type = optax.softmax_cross_entropy_with_integer_labels(
+            out["type"].astype(jnp.float32), jnp.zeros_like(y)).mean()
+        return ce + 0.1 * ce_type
+
+    tx = optax.adam(optax.cosine_decay_schedule(lr, steps, alpha=0.05))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32),
+                          model.params)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, u8, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, u8, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    history = []
+    for step in range(steps):
+        idx = rng.integers(0, n_crops, size=batch)
+        params, opt_state, loss = train_step(
+            params, opt_state, jnp.asarray(crops[idx]),
+            jnp.asarray(attrs[idx]))
+        if step % 50 == 0 or step == steps - 1:
+            history.append(float(loss))
+            log.info("fit_classifier step %d loss %.4f",
+                     step, float(loss))
+    return params, history
+
+
+def evaluate_attrs(
+    packed: np.ndarray,
+    scenes: list[Scene],
+    n_colors: int = 7,
+    iou_thresh: float = 0.5,
+) -> dict:
+    """Score the fused detect+classify output against vehicle color
+    ground truth. Rows are ``[x0 y0 x1 y1 score label valid,
+    color_probs(n_colors), ...]``. A GT vehicle counts recovered iff a
+    valid label-2 detection matches at IoU ≥ iou_thresh AND its color
+    argmax equals the scene attr."""
+    tp, n_gt = 0, 0
+    misses = []
+    for scene, rows in zip(scenes, packed):
+        for gt_box, gt_label, gt_attr in zip(
+                scene.boxes, scene.labels, scene.attrs):
+            if int(gt_label) != 2:
+                continue
+            n_gt += 1
+            hit = False
+            for row in np.asarray(rows):
+                if row[6] <= 0.5 or int(row[5]) != 2:
+                    continue
+                if _pairwise_iou(
+                        row[None, :4].astype(np.float32),
+                        gt_box[None])[0, 0] < iou_thresh:
+                    continue
+                probs = row[7:7 + n_colors]
+                if probs.sum() <= 0:
+                    continue  # ROI budget skipped this detection
+                hit = int(probs.argmax()) == int(gt_attr)
+                break
+            if hit:
+                tp += 1
+            else:
+                misses.append({"attr": int(gt_attr),
+                               "box": gt_box.tolist()})
+    return {"attr_recall": tp / max(n_gt, 1), "gt": n_gt,
+            "misses": misses}
 
 
 def save_fitted(params, key: str, models_dir: str | Path,

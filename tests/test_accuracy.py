@@ -172,3 +172,125 @@ def test_serving_path_publishes_ground_truth(fitted, tmp_path):
     recall = tp / max(n_gt, 1)
     assert recall >= 0.65, (
         f"serving path recovered {tp}/{n_gt} ground-truth boxes")
+
+
+class TestFusedClassifyAccuracy:
+    """Ground truth through the fused detect+classify program — the
+    on-device i420 wire-plane ROI crop (`ops.color.crop_rois_i420`)
+    is geometry no shape test can validate: a shifted/flipped crop
+    reads the wrong pixels and the color head answers garbage."""
+
+    @pytest.fixture(scope="class")
+    def fitted_pair(self, tmp_path_factory):
+        det_reg = ModelRegistry(dtype="float32",
+                                input_overrides={KEY: INPUT},
+                                width_overrides={KEY: WIDTH},
+                                allow_random_weights=True)
+        det_model = det_reg.get(KEY)
+        det_params, hist = acc.fit_detector(
+            det_model, steps=1200, n_scenes=128, color_attr=True)
+        assert hist[-1] < 0.6, f"detector fit did not converge: {hist}"
+
+        cls_key = "object_classification/vehicle_attributes"
+        cls_reg = ModelRegistry(
+            dtype="float32",
+            input_overrides={cls_key: (48, 48)},
+            width_overrides={cls_key: 16},
+            allow_random_weights=True)
+        cls_model = cls_reg.get(cls_key)
+        cls_params, chist = acc.fit_classifier(
+            cls_model, steps=900, n_crops=768)
+        assert chist[-1] < 0.2, f"classifier fit did not converge: {chist}"
+        return det_model, det_params, cls_model, cls_params
+
+    def test_fused_wire_path_recovers_vehicle_colors(self, fitted_pair):
+        import jax
+
+        from evam_tpu.engine.steps import build_detect_classify_step
+        from evam_tpu.ops.color import bgr_to_i420_host
+
+        det_model, det_params, cls_model, cls_params = fitted_pair
+        rng = np.random.default_rng(123)
+        scenes = [acc.render_scene(rng, hw=(1080, 1920),
+                                   color_attr=True)
+                  for _ in range(16)]
+        wire = np.stack([bgr_to_i420_host(s.frame) for s in scenes])
+        step = build_detect_classify_step(
+            det_model, cls_model, max_detections=16, roi_budget=8,
+            score_threshold=0.3, wire_format="i420",
+            allowed_label_ids=(2,))
+        packed = np.asarray(jax.jit(step)(
+            {"det": det_params, "cls": cls_params}, wire))
+        report = acc.evaluate_attrs(packed, scenes)
+        if report["gt"] < 4:  # rng gave too few vehicles: widen
+            more = [acc.render_scene(rng, hw=(1080, 1920),
+                                     color_attr=True)
+                    for _ in range(10)]
+            wire2 = np.stack(
+                [bgr_to_i420_host(s.frame) for s in more])
+            packed2 = np.asarray(jax.jit(step)(
+                {"det": det_params, "cls": cls_params}, wire2))
+            r2 = acc.evaluate_attrs(packed2, more)
+            report = {
+                "attr_recall": (report["attr_recall"] * report["gt"]
+                                + r2["attr_recall"] * r2["gt"])
+                / max(report["gt"] + r2["gt"], 1),
+                "gt": report["gt"] + r2["gt"],
+                "misses": report["misses"] + r2["misses"],
+            }
+        assert report["gt"] >= 4, report
+        assert report["attr_recall"] >= 0.6, report
+
+    def test_shifted_crops_break_color_recovery(self, fitted_pair):
+        """Negative control: shifting every ROI box by half a box
+        width must wreck color recovery — proving the assertion sees
+        crop geometry, not just global image statistics."""
+        import jax
+        import jax.numpy as jnp
+
+        from evam_tpu.models.accuracy import ATTR_COLORS_BGR
+        from evam_tpu.ops.color import bgr_to_i420_host, crop_rois_i420
+
+        det_model, det_params, cls_model, cls_params = fitted_pair
+        rng = np.random.default_rng(321)
+        # one big centered vehicle per scene: a half-width shift moves
+        # the crop mostly onto background
+        scenes = []
+        for _ in range(8):
+            s = acc.render_scene(rng, hw=(1080, 1920), color_attr=True)
+            scenes.append(s)
+        wire = np.stack([bgr_to_i420_host(s.frame) for s in scenes])
+
+        pre = cls_model.preprocess
+        hits = shifted_hits = total = 0
+        for s, w in zip(scenes, wire):
+            for box, label, attr in zip(s.boxes, s.labels, s.attrs):
+                if int(label) != 2:
+                    continue
+                total += 1
+                for shift, counter in ((0.0, "ok"), (0.6, "bad")):
+                    bw = box[2] - box[0]
+                    b = np.asarray(
+                        [[min(box[0] + shift * bw, 1.0),
+                          box[1],
+                          min(box[2] + shift * bw, 1.0),
+                          box[3]]], np.float32)
+                    crop = crop_rois_i420(
+                        w[None], jnp.asarray(b[None]),
+                        (pre.height, pre.width))[0, 0]
+                    from evam_tpu.ops.preprocess import preprocess_bgr
+                    x = preprocess_bgr(
+                        jnp.asarray(crop)[None].astype(jnp.float32),
+                        pre)
+                    out = cls_model.forward(cls_params, x)
+                    got = int(np.asarray(out["color"][0]).argmax())
+                    if got == int(attr):
+                        if counter == "ok":
+                            hits += 1
+                        else:
+                            shifted_hits += 1
+        assert total >= 3, total
+        assert hits / total >= 0.7, (hits, total)
+        assert shifted_hits / total <= 0.5, (
+            f"shifted crops should not recover colors "
+            f"({shifted_hits}/{total})")

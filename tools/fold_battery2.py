@@ -69,11 +69,15 @@ def main() -> int:
             merged = json.loads(dest.read_text())
         except json.JSONDecodeError:
             merged = {}
+    def _bad(v) -> bool:
+        # unparsed tail OR a parsed failure line (bench emits
+        # {"value": 0.0, "error": ...} on wedge/fit failures)
+        return isinstance(v, dict) and ("unparsed" in v or "error" in v)
+
     for name, val in folded.items():
         prior = merged.get(name)
-        if (isinstance(val, dict) and "unparsed" in val
-                and isinstance(prior, dict) and "unparsed" not in prior):
-            continue
+        if _bad(val) and prior is not None and not _bad(prior):
+            continue  # a failed re-arm never clobbers a good record
         merged[name] = val
     dest.write_text(json.dumps(merged, indent=1) + "\n")
     print("\n".join(lines))
