@@ -68,6 +68,19 @@ class Scene:
     attrs: np.ndarray | None = None  # int32 [N] color idx; -1 = n/a
 
 
+def _textured_bg(rng: np.random.Generator, h: int, w: int,
+                 base: int | None = None) -> np.ndarray:
+    """Mildly textured background (8×8 noise tiles) so nets cannot key
+    on a flat value — shared by every renderer in this module."""
+    if base is None:
+        base = int(rng.integers(96, 160))
+    noise = rng.integers(0, 24, (h // 8 + 1, w // 8 + 1, 3), np.uint8)
+    return np.clip(
+        np.full((h, w, 3), base, np.int16)
+        + np.kron(noise, np.ones((8, 8, 1), np.int16))[:h, :w] - 12,
+        0, 255).astype(np.uint8)
+
+
 def render_scene(
     rng: np.random.Generator,
     hw: tuple[int, int] = (1080, 1920),
@@ -85,14 +98,7 @@ def render_scene(
     overlap (IoU > 0.1) so ground truth is unambiguous for NMS.
     """
     h, w = hw
-    base = rng.integers(96, 160)
-    frame = np.full((h, w, 3), base, np.uint8)
-    # mild texture so the net cannot key on flat background value
-    noise = rng.integers(0, 24, (h // 8 + 1, w // 8 + 1, 3), np.uint8)
-    frame = np.clip(
-        frame.astype(np.int16)
-        + np.kron(noise, np.ones((8, 8, 1), np.int16))[:h, :w] - 12,
-        0, 255).astype(np.uint8)
+    frame = _textured_bg(rng, h, w)
 
     n = int(rng.integers(1, max_objects + 1))
     boxes, labels, attrs = [], [], []
@@ -305,6 +311,42 @@ def fit_detector(
     return params, history
 
 
+def _fit_loop(loss_fn, arrays, *, init_params, steps, batch, lr,
+              rng, name):
+    """Shared harness trainer: adam + cosine decay, jitted step,
+    with-replacement minibatches, every-50-step loss history (the
+    convergence signal the tests assert on). Used by the classifier /
+    action / audio fits; fit_detector keeps its epoch-shuffled
+    variant (hard-negative mining wants full-epoch coverage)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    tx = optax.adam(optax.cosine_decay_schedule(lr, steps, alpha=0.05))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32),
+                          init_params)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, *batch_arrays):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, *batch_arrays)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n = arrays[0].shape[0]
+    history = []
+    for step in range(steps):
+        idx = rng.integers(0, n, size=batch)
+        params, opt_state, loss = train_step(
+            params, opt_state,
+            *(jnp.asarray(a[idx]) for a in arrays))
+        if step % 50 == 0 or step == steps - 1:
+            history.append(float(loss))
+            log.info("%s step %d loss %.4f", name, step, float(loss))
+    return params, history
+
+
 def render_vehicle_crop(
     rng: np.random.Generator, attr: int,
     out_hw: tuple[int, int],
@@ -390,28 +432,10 @@ def fit_classifier(
             out["type"].astype(jnp.float32), jnp.zeros_like(y)).mean()
         return ce + 0.1 * ce_type
 
-    tx = optax.adam(optax.cosine_decay_schedule(lr, steps, alpha=0.05))
-    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32),
-                          model.params)
-    opt_state = tx.init(params)
-
-    @jax.jit
-    def train_step(params, opt_state, u8, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, u8, y)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    history = []
-    for step in range(steps):
-        idx = rng.integers(0, n_crops, size=batch)
-        params, opt_state, loss = train_step(
-            params, opt_state, jnp.asarray(crops[idx]),
-            jnp.asarray(attrs[idx]))
-        if step % 50 == 0 or step == steps - 1:
-            history.append(float(loss))
-            log.info("fit_classifier step %d loss %.4f",
-                     step, float(loss))
-    return params, history
+    return _fit_loop(
+        loss_fn, (crops, attrs), init_params=model.params,
+        steps=steps, batch=batch, lr=lr, rng=rng,
+        name="fit_classifier")
 
 
 def evaluate_attrs(
@@ -453,6 +477,171 @@ def evaluate_attrs(
                                "box": gt_box.tolist()})
     return {"attr_recall": tp / max(n_gt, 1), "gt": n_gt,
             "misses": misses}
+
+
+# ------------------------------------------------- temporal families
+
+#: The 4 temporal ground-truth classes, mapped onto action class
+#: slots 0..3: grow / shrink (object area ramps up or down across the
+#: clip) and brighten / darken (object intensity ramps). Chosen to be
+#: (a) expressible by this encoder family — ActionEncoder ends in
+#: global average pooling, so per-frame features are translation-
+#: invariant scalars like covered area and intensity (block POSITION
+#: is invisible by construction, which is why motion-direction
+#: classes are unlearnable here) — and (b) strictly ORDER-dependent:
+#: grow/shrink (and brighten/darken) clips contain the same frame
+#: SET reversed, so the decoder must use its positional embedding.
+#: A single frame is ambiguous between each pair.
+TEMPORAL_CLASSES = ("grow", "shrink", "brighten", "darken")
+
+
+def render_temporal_clip(
+    rng: np.random.Generator,
+    cls: int,
+    hw: tuple[int, int],
+    clip_len: int = 16,
+) -> np.ndarray:
+    """[T, H, W, 3] uint8 BGR clip for one TEMPORAL_CLASSES entry.
+    Center, base size and background are randomized so the temporal
+    ramp is the only class cue."""
+    h, w = hw
+    bg = _textured_bg(rng, h, w, base=int(rng.integers(96, 150)))
+    cy = rng.uniform(0.35, 0.65) * h
+    cx = rng.uniform(0.35, 0.65) * w
+    frames = []
+    for t in range(clip_len):
+        frac = t / (clip_len - 1)
+        if cls == 0:      # grow
+            scale, value = 0.14 + 0.26 * frac, 235
+        elif cls == 1:    # shrink
+            scale, value = 0.40 - 0.26 * frac, 235
+        elif cls == 2:    # brighten
+            scale, value = 0.28, int(40 + 195 * frac)
+        else:             # darken
+            scale, value = 0.28, int(235 - 195 * frac)
+        bh = max(int(scale * h), 2)
+        bw = max(int(scale * w), 2)
+        y0 = int(np.clip(cy - bh / 2, 0, h - bh))
+        x0 = int(np.clip(cx - bw / 2, 0, w - bw))
+        f = bg.copy()
+        f[y0:y0 + bh, x0:x0 + bw] = (value, value, max(value - 30, 0))
+        frames.append(f)
+    return np.stack(frames)
+
+
+def fit_action(
+    enc_model, dec_model,
+    seed: int = 2,
+    n_clips: int = 128,
+    steps: int = 600,
+    batch: int = 8,
+    lr: float = 5e-4,   # depth-4 transformer oscillates at 2e-3
+    source_hw: tuple[int, int] | None = (64, 96),
+):
+    """Jointly fit the action encoder+decoder to the 4
+    TEMPORAL_CLASSES (class ids 0..3 of the 400-way decoder). Half
+    the clips render at the encoder input size, half at ``source_hw``
+    and get resized — the serving path stretches source frames
+    on-device. Returns ``((enc_params, dec_params), history)``."""
+    import cv2
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from evam_tpu.ops.preprocess import preprocess_bgr
+
+    h, w = enc_model.spec.input_size
+    clip_len = 16
+    rng = np.random.default_rng(seed)
+    clips, ys = [], []
+    for i in range(n_clips):
+        d = int(rng.integers(0, 4))
+        if i % 2 == 0 or source_hw is None:
+            clip = render_temporal_clip(rng, d, (h, w), clip_len)
+        else:
+            big = render_temporal_clip(rng, d, source_hw, clip_len)
+            clip = np.stack([
+                cv2.resize(f, (w, h), interpolation=cv2.INTER_AREA)
+                for f in big])
+        clips.append(clip)
+        ys.append(d)
+    clips = np.stack(clips)          # [N, T, h, w, 3]
+    ys = np.asarray(ys, np.int32)
+
+    enc_pre = enc_model.preprocess
+    enc_mod, dec_mod = enc_model.module, dec_model.module
+
+    def loss_fn(params, clip_u8, y):
+        b, t = clip_u8.shape[:2]
+        x = preprocess_bgr(
+            clip_u8.reshape((b * t,) + clip_u8.shape[2:])
+            .astype(jnp.float32), enc_pre)
+        emb = enc_mod.apply({"params": params["enc"]}, x)
+        emb = emb.reshape(b, t, -1).astype(jnp.float32)
+        logits = dec_mod.apply(
+            {"params": params["dec"]}, emb).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    params, history = _fit_loop(
+        loss_fn, (clips, ys),
+        init_params={"enc": enc_model.params, "dec": dec_model.params},
+        steps=steps, batch=batch, lr=lr, rng=rng, name="fit_action")
+    return (params["enc"], params["dec"]), history
+
+
+#: audio class → tone frequency (Hz); well separated under the 8 kHz
+#: Nyquist of the 16 kHz serving rate, mapped onto class slots 0..3
+TONE_FREQS = (400.0, 1000.0, 2500.0, 5000.0)
+
+
+def render_tone_window(
+    rng: np.random.Generator, cls: int, n_samples: int,
+    sample_rate: float = 16000.0,
+) -> np.ndarray:
+    """One S16LE window: a sine at the class frequency with random
+    phase/amplitude plus noise — amplitude and phase vary so
+    FREQUENCY is the only class cue."""
+    t = np.arange(n_samples, dtype=np.float64) / sample_rate
+    amp = rng.uniform(0.25, 0.8)
+    phase = rng.uniform(0, 2 * np.pi)
+    x = amp * np.sin(2 * np.pi * TONE_FREQS[cls] * t + phase)
+    x = x + rng.normal(0, 0.02, n_samples)
+    return np.clip(x * 32767, -32768, 32767).astype(np.int16)
+
+
+def fit_audio(
+    model,
+    seed: int = 3,
+    n_windows: int = 512,
+    steps: int = 400,
+    batch: int = 32,
+    lr: float = 3e-3,
+):
+    """Fit AclNet to the 4 tone classes through the serving
+    normalization (int16 / 32768, mirroring
+    engine.steps.build_audio_step). Returns ``(params, history)``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    n_samples = model.spec.input_size[1]
+    rng = np.random.default_rng(seed)
+    ys = rng.integers(0, len(TONE_FREQS), size=n_windows)
+    xs = np.stack([
+        render_tone_window(rng, int(c), n_samples) for c in ys])
+    module = model.module
+
+    def loss_fn(params, win_i16, y):
+        x = win_i16.astype(jnp.float32) / 32768.0
+        logits = module.apply(
+            {"params": params}, x).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    return _fit_loop(
+        loss_fn, (xs, ys), init_params=model.params,
+        steps=steps, batch=batch, lr=lr, rng=rng, name="fit_audio")
 
 
 def save_fitted(params, key: str, models_dir: str | Path,

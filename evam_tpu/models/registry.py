@@ -263,7 +263,10 @@ def build_module(spec: ModelSpec, overrides: dict[str, Any] | None = None):
     if spec.family == "action_encoder":
         return ActionEncoder(width=width)
     if spec.family == "action_decoder":
-        return ActionDecoder(num_classes=spec.num_classes)
+        # width scales the transformer dim (default width 32 → the
+        # reference-shaped dim 512); heads=8 needs dim % 8 == 0
+        return ActionDecoder(num_classes=spec.num_classes,
+                             dim=width * 16)
     if spec.family == "action":
         return ActionRecognizer(num_classes=spec.num_classes)
     if spec.family == "aclnet":

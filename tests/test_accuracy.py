@@ -294,3 +294,175 @@ class TestFusedClassifyAccuracy:
         assert shifted_hits / total <= 0.5, (
             f"shifted crops should not recover colors "
             f"({shifted_hits}/{total})")
+
+
+class TestInt8Accuracy:
+    """EVAM_PRECISION=int8 serves quantized module variants over the
+    same float checkpoint. Quantization bugs degrade accuracy
+    SILENTLY — shape/finiteness tests pass regardless — so the
+    ground-truth harness is the only offline thing that can catch
+    them: the int8 path must recover the same scenes the float path
+    does."""
+
+    def test_int8_detect_preserves_ground_truth(self, fitted):
+        import jax
+
+        from evam_tpu.engine.steps import build_detect_step
+        from evam_tpu.ops.color import bgr_to_i420_host
+
+        models_dir, _params, _model = fitted
+        reg8 = ModelRegistry(dtype="int8",
+                             models_dir=str(models_dir),
+                             input_overrides={KEY: INPUT},
+                             width_overrides={KEY: WIDTH})
+        model8 = reg8.get(KEY)
+        assert model8.module.quant
+        assert model8.weight_source != "random-init"
+
+        scenes = _holdout_scenes()
+        wire = np.stack([bgr_to_i420_host(s.frame) for s in scenes])
+        step8 = build_detect_step(model8, max_detections=16,
+                                  score_threshold=0.3,
+                                  wire_format="i420")
+        packed8 = np.asarray(jax.jit(step8)(model8.params, wire))
+        report8 = acc.evaluate_packed(packed8, scenes)
+        # float path on the same scenes asserts >= 0.75
+        # (test_wire_path_recovers_ground_truth); int8 may cost a
+        # little accuracy but must stay in the same regime
+        assert report8["recall"] >= 0.7, report8
+        assert report8["precision"] >= 0.6, report8
+
+
+class TestTemporalAccuracy:
+    """Ground truth for the temporal families: the action clip path
+    (per-frame encoder → 16-frame sliding clip → decoder) must
+    recover TEMPORAL classes (grow/shrink/brighten/darken — order-
+    dependent ramps; see accuracy.TEMPORAL_CLASSES for why not
+    motion direction), and the audio sliding-window path must
+    recover TONE classes — through the real stages, engines and
+    metaconvert, not model-level shortcuts."""
+
+    ENC = "action_recognition/encoder"
+    DEC = "action_recognition/decoder"
+    AUD = "audio_detection/environment"
+
+    @pytest.fixture(scope="class")
+    def fitted_temporal(self, tmp_path_factory):
+        reg = ModelRegistry(
+            dtype="float32",
+            input_overrides={self.ENC: (48, 48)},
+            width_overrides={self.ENC: 8, self.DEC: 8, self.AUD: 8},
+            allow_random_weights=True)
+        enc, dec = reg.get(self.ENC), reg.get(self.DEC)
+        (ep, dp), hist = acc.fit_action(enc, dec)
+        assert hist[-1] < 0.6, f"action fit did not converge: {hist}"
+        aud = reg.get(self.AUD)
+        ap, ahist = acc.fit_audio(aud)
+        assert ahist[-1] < 0.3, f"audio fit did not converge: {ahist}"
+
+        models_dir = tmp_path_factory.mktemp("temporal_models")
+        acc.save_fitted(ep, self.ENC, models_dir)
+        acc.save_fitted(dp, self.DEC, models_dir)
+        acc.save_fitted(ap, self.AUD, models_dir)
+        return models_dir
+
+    def _hub(self, models_dir):
+        from evam_tpu.engine import EngineHub
+        from evam_tpu.parallel import build_mesh
+
+        reg = ModelRegistry(
+            dtype="float32", models_dir=str(models_dir),
+            input_overrides={self.ENC: (48, 48)},
+            width_overrides={self.ENC: 8, self.DEC: 8, self.AUD: 8})
+        return EngineHub(reg, plan=build_mesh(), max_batch=16,
+                         deadline_ms=4.0)
+
+    @staticmethod
+    def _run(loader, hub, family, variant, params, source):
+        from evam_tpu.graph import resolve_parameters
+        from evam_tpu.stages import StreamRunner, build_stages
+
+        spec = loader.get(family, variant)
+        stages_spec, _ = resolve_parameters(spec, params)
+        outputs = []
+        runner = StreamRunner(
+            "acc", build_stages(
+                stages_spec, hub, source_uri="synthetic://acc",
+                publish_fn=lambda ctx: outputs.append(ctx.metadata)),
+            source_uri="synthetic://acc")
+        runner.run(source)
+        return outputs
+
+    def test_action_clip_path_recovers_motion(self, fitted_temporal):
+        from pathlib import Path
+
+        from evam_tpu.graph import PipelineLoader
+        from evam_tpu.media.source import FrameEvent
+
+        repo = Path(__file__).resolve().parent.parent
+        loader = PipelineLoader(repo / "pipelines")
+        hub = self._hub(fitted_temporal)
+        try:
+            rng = np.random.default_rng(42)
+            correct = total = 0
+            for direction in (0, 1, 2, 3):
+                clip = acc.render_temporal_clip(
+                    rng, direction, (64, 96), 16)
+
+                def frames(clip=clip):
+                    for i, f in enumerate(clip):
+                        yield FrameEvent(frame=f, pts_ns=i * 33, seq=i)
+
+                outputs = self._run(
+                    loader, hub, "action_recognition", "general",
+                    {}, frames())
+                assert len(outputs) == 16
+                acted = [m for m in outputs if m.get("tensors")]
+                # exactly the 16th frame completes the clip
+                assert len(acted) == 1, len(acted)
+                data = np.asarray(acted[0]["tensors"][0]["data"])
+                assert data.shape == (400,)
+                total += 1
+                correct += int(data.argmax()) == direction
+            assert correct >= 3, f"{correct}/{total} motions recovered"
+        finally:
+            hub.stop()
+
+    def test_audio_window_path_recovers_tones(self, fitted_temporal):
+        from pathlib import Path
+
+        from evam_tpu.graph import PipelineLoader
+        from evam_tpu.media.source import FrameEvent
+
+        repo = Path(__file__).resolve().parent.parent
+        loader = PipelineLoader(repo / "pipelines")
+        hub = self._hub(fitted_temporal)
+        try:
+            correct = total = 0
+            for cls in (0, 1, 2, 3):
+                # 2 s of continuous-phase tone in 100 ms chunks
+                t = np.arange(32000, dtype=np.float64) / 16000.0
+                wave = np.clip(
+                    0.5 * np.sin(2 * np.pi * acc.TONE_FREQS[cls] * t)
+                    * 32767, -32768, 32767).astype(np.int16)
+
+                def chunks(wave=wave):
+                    for i in range(0, len(wave), 1600):
+                        yield FrameEvent(
+                            frame=None, audio=wave[i:i + 1600],
+                            pts_ns=i, seq=i // 1600)
+
+                outputs = self._run(
+                    loader, hub, "audio_detection", "environment",
+                    {"threshold": 0.0, "sliding-window": 0.5},
+                    chunks())
+                dets = [m["tensors"][0] for m in outputs
+                        if m.get("tensors")]
+                assert dets, "no audio windows classified"
+                total += 1
+                ids = [d["label_id"] for d in dets]
+                # majority vote over the windows of this tone
+                correct += max(set(ids), key=ids.count) == cls
+            assert correct >= 3, f"{correct}/{total} tones recovered"
+        finally:
+            hub.stop()
