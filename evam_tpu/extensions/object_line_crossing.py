@@ -17,7 +17,10 @@ from evam_tpu.stages.context import FrameContext
 
 
 def _side(p: np.ndarray, a: np.ndarray, b: np.ndarray) -> float:
-    return float(np.cross(b - a, p - a))
+    # explicit 2-D cross product: np.cross on 2-D vectors is
+    # deprecated (NumPy 2.0) and will be removed
+    u, v = b - a, p - a
+    return float(u[0] * v[1] - u[1] * v[0])
 
 
 def _segments_intersect(p1, p2, a, b) -> bool:
