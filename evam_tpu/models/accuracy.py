@@ -81,6 +81,18 @@ def _textured_bg(rng: np.random.Generator, h: int, w: int,
         0, 255).astype(np.uint8)
 
 
+def _draw_object(frame: np.ndarray, xi: int, yi: int, xe: int, ye: int,
+                 color: tuple, inner: tuple | None = None) -> None:
+    """The harness's object idiom: solid fill + quarter-inset interior
+    (``inner``; default half-brightness). One definition so every
+    renderer (scenes, moving-object sequences) draws the same
+    distribution the detector was fitted on."""
+    frame[yi:ye, xi:xe] = color
+    iy, ix = max((ye - yi) // 4, 1), max((xe - xi) // 4, 1)
+    frame[yi + iy:ye - iy, xi + ix:xe - ix] = (
+        tuple(c // 2 for c in color) if inner is None else inner)
+
+
 def render_scene(
     rng: np.random.Generator,
     hw: tuple[int, int] = (1080, 1920),
@@ -117,21 +129,15 @@ def render_scene(
             if boxes and _max_iou(cand, np.stack(boxes)) > 0.1:
                 continue
             xi, yi, xe, ye = (int(x0), int(y0), int(x0 + bw), int(y0 + bh))
-            frame[yi:ye, xi:xe] = color
-            iy, ix = max((ye - yi) // 4, 1), max((xe - xi) // 4, 1)
             attr = -1
+            inner = None  # default: darker band for internal structure
             if color_attr and cls == 2:
                 # classification ground truth: vehicle interior takes
                 # one of the 7 VEHICLE_COLORS; the border keeps the
                 # class color so detection stays learnable
                 attr = int(rng.integers(0, len(ATTR_COLORS_BGR)))
-                frame[yi + iy:ye - iy, xi + ix:xe - ix] = \
-                    ATTR_COLORS_BGR[attr]
-            else:
-                # a darker inner band gives each class internal
-                # structure
-                frame[yi + iy:ye - iy, xi + ix:xe - ix] = tuple(
-                    c // 2 for c in color)
+                inner = ATTR_COLORS_BGR[attr]
+            _draw_object(frame, xi, yi, xe, ye, color, inner)
             boxes.append(cand)
             labels.append(cls)
             attrs.append(attr)
@@ -247,7 +253,8 @@ def fit_detector(
              n_scenes, anchors.shape[0], n_pos)
 
     pre = model.preprocess
-    module = model.module
+    fwd = model.forward  # (params, x) → {'conf', 'loc'}: the SERVING
+    # forward, so this fits zoo modules AND imported IR graphs alike
 
     def _model_input(u8):
         # the SERVING normalization op, not a copy — training and
@@ -260,8 +267,11 @@ def fit_detector(
     variances = model.variances
 
     def loss_fn(params, u8, cls_t, box_t):
-        out = module.apply({"params": params}, _model_input(u8))
+        out = fwd(params, _model_input(u8))
         conf = out["conf"].astype(jnp.float32)           # [B, A, C]
+        if model.conf_is_prob:
+            # IR graphs may softmax in-graph: recover logits for CE
+            conf = jnp.log(conf + 1e-9)
         loc = out["loc"].astype(jnp.float32)             # [B, A, 4]
         pos = (cls_t > 0)
         # localization: smooth-L1 on encoded offsets, positives only
