@@ -315,3 +315,79 @@ def test_pool_lossless_mode_never_drops():
     assert ps.frames_dropped == 0
     assert ps.error is None
     pool.stop()
+
+
+def test_pool_churn_add_close_stop_race():
+    """Concurrency churn: streams added/closed from another thread
+    while workers decode; closing mid-decode, double-close, and
+    stop() with live streams must all resolve cleanly (every stream
+    reaches EOS, no worker deadlocks)."""
+    import threading
+
+    from evam_tpu.media import DecodePool
+
+    pool = DecodePool(workers=3, restart_backoff_s=0.01)
+    done = []
+
+    def consume(ps):
+        frames = list(ps.frames())
+        done.append((ps.stream_id, len(frames)))
+
+    threads = []
+    streams = []
+    for i in range(12):
+        ps = pool.add_stream(
+            f"churn{i}",
+            lambda: SyntheticSource(width=32, height=32, count=40),
+            maxsize=4, drop_when_full=(i % 2 == 0))
+        streams.append(ps)
+        t = threading.Thread(target=consume, args=(ps,), daemon=True)
+        t.start()
+        threads.append(t)
+    # close a third of them mid-flight (some possibly already done)
+    time.sleep(0.05)
+    for ps in streams[::3]:
+        ps.close()
+        ps.close()  # double-close must be harmless
+    for t in threads:
+        t.join(timeout=30)
+    assert all(not t.is_alive() for t in threads), "consumer hung"
+    assert len(done) == 12
+    by_id = dict(done)
+    for i, ps in enumerate(streams):
+        if i % 3 == 0:
+            continue  # closed mid-flight: any frame count is fine
+        if not ps.drop_when_full:
+            # untouched lossless streams decoded everything
+            assert by_id[f"churn{i}"] == 40, (i, by_id)
+    pool.stop()
+    pool.stop()  # idempotent
+
+    # stop() with LIVE streams: long paced streams are mid-decode
+    # when the pool goes down; every consumer must still see EOS
+    pool2 = DecodePool(workers=2)
+    live = [
+        pool2.add_stream(
+            f"live{i}",
+            lambda: SyntheticSource(width=32, height=32, count=10_000),
+            fps=200.0, maxsize=8)
+        for i in range(4)
+    ]
+    got_eos = []
+
+    def drain(ps):
+        for _ in ps.frames():
+            pass
+        got_eos.append(ps.stream_id)
+
+    dthreads = [threading.Thread(target=drain, args=(s,), daemon=True)
+                for s in live]
+    for t in dthreads:
+        t.start()
+    time.sleep(0.2)  # streams are genuinely mid-decode
+    pool2.stop()
+    for t in dthreads:
+        t.join(timeout=10)
+    assert all(not t.is_alive() for t in dthreads), "drain hung on stop"
+    assert len(got_eos) == 4
+    assert all(s.finished for s in live)
