@@ -22,6 +22,7 @@ ENTRIES = [
     ("serve_file_32", "serving path, 32 streams, file publish"),
     ("serve_ir", "serving path, 64 streams, manifest IR models"),
     ("detect_ir", "detect bench, manifest IR person_vehicle_bike"),
+    ("detect_int8", "detect bench, int8 quantized modules"),
     ("sweep40", "operating-point sweep @ p99<40ms"),
     ("blocking", "block_until_ready probe (action/audio programs)"),
     ("action", "action streams (enc+dec combined metric)"),

@@ -63,6 +63,10 @@ run detect_ir 600 python bench.py --config detect --models-dir "$IRDIR" --second
 # ---- host-ingest point
 run host 600 python bench.py --ingest host --batch 8 --depth 2 --seconds 6
 
+# ---- int8 quantized path (same checkpoint family, quant modules):
+# if the MXU int8 path beats bf16, this is a headline lever
+run detect_int8 600 python bench.py --config detect --precision int8 --seconds 8
+
 # ---- THE serve family, LAST (r3 item 1). Shorter wrapper timeouts:
 # a wedge here costs <=15 min per entry and nothing upstream.
 run serve 900 python bench.py --config serve --streams 64 --seconds 24 --batch 256 --stall-timeout 180
