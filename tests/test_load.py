@@ -22,15 +22,24 @@ SMALL["audio_detection/environment"] = (1, 1600)
 NARROW = {k: 8 for k in ZOO_SPECS}
 
 
-@pytest.fixture(scope="module")
-def registry(eight_devices):
-    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+def make_registry(settings_kw: dict | None = None,
+                  hub_kw: dict | None = None) -> PipelineRegistry:
+    """The CI serving shape (SMALL/NARROW models, b16/4ms engines) —
+    one definition for every load test's registry."""
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"),
+                        **(settings_kw or {}))
     hub = EngineHub(
         ModelRegistry(dtype="float32", input_overrides=SMALL,
                       width_overrides=NARROW),
         plan=build_mesh(), max_batch=16, deadline_ms=4.0,
+        **(hub_kw or {}),
     )
-    reg = PipelineRegistry(settings, hub=hub)
+    return PipelineRegistry(settings, hub=hub)
+
+
+@pytest.fixture(scope="module")
+def registry(eight_devices):
+    reg = make_registry()
     yield reg
     reg.stop_all()
 
@@ -158,14 +167,7 @@ class TestDeviceSynthServe:
     behave identically — completion, batching, latency histogram."""
 
     def test_synth_streams_complete_and_batch(self, eight_devices):
-        settings = Settings(pipelines_dir=str(REPO / "pipelines"))
-        hub = EngineHub(
-            ModelRegistry(dtype="float32", input_overrides=SMALL,
-                          width_overrides=NARROW),
-            plan=build_mesh(), max_batch=16, deadline_ms=4.0,
-            device_synth=True,
-        )
-        reg = PipelineRegistry(settings, hub=hub)
+        reg = make_registry(hub_kw={"device_synth": True})
         try:
             n, frames = 8, 12
             instances = [
@@ -235,3 +237,54 @@ class TestFaultInjection:
 
         monkeypatch.delenv("EVAM_FAULT_INJECT", raising=False)
         assert faults.from_env() is None
+
+
+class TestDecodePoolLoad:
+    """16 streams through the shared DecodePool (lossless) + the
+    shared engine: the pooled decode path must deliver every frame at
+    load, with total decode threads bounded at the pool size."""
+
+    N_STREAMS = 16
+    FRAMES = 20
+
+    def test_16_pooled_streams_lossless(self, eight_devices):
+        import threading as _t
+
+        reg = make_registry(settings_kw={"decode_pool_workers": 2})
+        try:
+            before = {
+                t.ident for t in _t.enumerate()
+                if t.name.startswith("decode-pool")
+            }
+            assert len(before) == 2  # pool built at registry init
+            instances = [
+                reg.start_instance(
+                    "object_detection", "person_vehicle_bike",
+                    {
+                        "source": {
+                            "uri": f"synthetic://96x96@30"
+                                   f"?count={self.FRAMES}&seed={i}",
+                            "type": "uri",
+                        },
+                        "destination": {"metadata": {"type": "null"}},
+                    },
+                )
+                for i in range(self.N_STREAMS)
+            ]
+            # the SAME two worker threads serve all 16 streams —
+            # start_instance must never spawn decode threads/pools
+            after = {
+                t.ident for t in _t.enumerate()
+                if t.name.startswith("decode-pool")
+            }
+            assert after == before
+            deadline = time.time() + 240
+            for inst in instances:
+                inst.wait(timeout=max(1, deadline - time.time()))
+            states = [i.state.value for i in instances]
+            assert states.count("COMPLETED") == self.N_STREAMS, states
+            # LOSSLESS through the pool: every decoded frame came out
+            total = sum(i._runner.frames_out for i in instances)
+            assert total == self.N_STREAMS * self.FRAMES
+        finally:
+            reg.stop_all()
