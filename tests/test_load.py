@@ -250,12 +250,16 @@ class TestDecodePoolLoad:
     def test_16_pooled_streams_lossless(self, eight_devices):
         import threading as _t
 
+        preexisting = {
+            t.ident for t in _t.enumerate()
+            if t.name.startswith("decode-pool")
+        }
         reg = make_registry(settings_kw={"decode_pool_workers": 2})
         try:
             before = {
                 t.ident for t in _t.enumerate()
                 if t.name.startswith("decode-pool")
-            }
+            } - preexisting
             assert len(before) == 2  # pool built at registry init
             instances = [
                 reg.start_instance(
@@ -276,7 +280,7 @@ class TestDecodePoolLoad:
             after = {
                 t.ident for t in _t.enumerate()
                 if t.name.startswith("decode-pool")
-            }
+            } - preexisting
             assert after == before
             deadline = time.time() + 240
             for inst in instances:

@@ -100,6 +100,16 @@ class TestServerEndToEnd:
             server.stop()
 
 
+#: the minimal browser-shaped SDP offer the signaler tests negotiate
+VIEWER_OFFER = "\r\n".join([
+    "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
+    "m=video 9 UDP/TLS/RTP/SAVPF 96",
+    "a=mid:0", "a=ice-ufrag:vuf", "a=ice-pwd:" + "v" * 22,
+    "a=fingerprint:sha-256 " + "CD:" * 31 + "CD",
+    "a=setup:active",
+])
+
+
 class TestWebRtcSignaler:
     def test_register_play_stream(self):
         import asyncio
@@ -152,6 +162,35 @@ class TestWebRtcSignaler:
         assert received["register"] == "cam0"
         assert received["frames"] >= 3
 
+    def test_video_mode_selects_session_kind(self):
+        """Settings.webrtc_video_mode plumbs through: delta mode gets
+        a per-viewer frame_source session (private encoder state),
+        key mode shares one SharedVp8Source payload across viewers."""
+        from evam_tpu.publish.webrtc import WebRtcSignaler
+
+        relay = FrameRelay("cam-mode")
+        delta_sig = WebRtcSignaler(
+            "ws://unused", "cam-mode", relay, video_mode="delta")
+        key_sig = WebRtcSignaler("ws://unused", "cam-mode", relay)
+        try:
+            ans = delta_sig._rtc_answer(VIEWER_OFFER, "p1")
+            assert ans and "a=rtcp-fb:96 nack pli" in ans
+            sess = delta_sig._sessions["p1"]
+            assert sess.video_mode == "delta"
+            assert sess.frame_source is not None
+            assert sess.payload_source is None
+
+            ans2 = key_sig._rtc_answer(VIEWER_OFFER, "p2")
+            assert ans2
+            sess2 = key_sig._sessions["p2"]
+            assert sess2.video_mode == "key"
+            assert sess2.payload_source is not None
+            assert key_sig._vp8 is not None  # shared encoder
+            assert delta_sig._vp8 is None    # per-viewer encoders
+        finally:
+            delta_sig.stop()
+            key_sig.stop()
+
     def test_sdp_offer_gets_media_answer(self):
         """The signaler answers an SDP offer with a real ice-lite +
         DTLS-passive + VP8 answer (the media plane itself is covered
@@ -165,13 +204,7 @@ class TestWebRtcSignaler:
         done = threading.Event()
         port_holder = {"ready": threading.Event()}
 
-        offer = "\r\n".join([
-            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-", "t=0 0",
-            "m=video 9 UDP/TLS/RTP/SAVPF 96",
-            "a=mid:0", "a=ice-ufrag:vuf", "a=ice-pwd:" + "v" * 22,
-            "a=fingerprint:sha-256 " + "CD:" * 31 + "CD",
-            "a=setup:active",
-        ])
+        offer = VIEWER_OFFER
 
         async def server_main():
             import websockets
