@@ -250,14 +250,16 @@ class TestDecodePoolLoad:
     def test_16_pooled_streams_lossless(self, eight_devices):
         import threading as _t
 
+        # Thread OBJECTS, not idents: idents are reused by CPython, so
+        # a leaked-then-exited pool thread could alias a new worker
         preexisting = {
-            t.ident for t in _t.enumerate()
+            t for t in _t.enumerate()
             if t.name.startswith("decode-pool")
         }
         reg = make_registry(settings_kw={"decode_pool_workers": 2})
         try:
             before = {
-                t.ident for t in _t.enumerate()
+                t for t in _t.enumerate()
                 if t.name.startswith("decode-pool")
             } - preexisting
             assert len(before) == 2  # pool built at registry init
@@ -278,7 +280,7 @@ class TestDecodePoolLoad:
             # the SAME two worker threads serve all 16 streams —
             # start_instance must never spawn decode threads/pools
             after = {
-                t.ident for t in _t.enumerate()
+                t for t in _t.enumerate()
                 if t.name.startswith("decode-pool")
             } - preexisting
             assert after == before

@@ -26,10 +26,13 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def test_soak_faulty_streams(monkeypatch):
+@pytest.mark.parametrize("pool_workers", [0, 2],
+                         ids=["per-stream", "decode-pool"])
+def test_soak_faulty_streams(monkeypatch, pool_workers):
     monkeypatch.setenv("EVAM_FAULT_INJECT",
                        "drop=0.05,stall=0.01,stall_ms=50,error=0.02")
-    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"),
+                        decode_pool_workers=pool_workers)
     hub = EngineHub(
         ModelRegistry(dtype="float32", input_overrides=SMALL,
                       width_overrides=NARROW),
