@@ -486,6 +486,21 @@ def main() -> int:
     if want:
         jax.config.update("jax_platforms", want)
 
+    # Persistent XLA executable cache, ON by default for the bench: a
+    # battery re-arm after a tunnel wedge must not re-pay (and
+    # re-risk) every compile. EVAM_COMPILE_CACHE_DIR overrides; set
+    # it to the empty string to disable. Per-user default path: a
+    # world-shared /tmp dir would be open to cross-user executable
+    # poisoning / permission collisions on shared hosts.
+    import tempfile
+
+    from evam_tpu.obs.trace import configure_compilation_cache
+
+    default_cache = os.path.join(
+        tempfile.gettempdir(), f"evam_xla_cache_{os.getuid()}")
+    configure_compilation_cache(
+        os.environ.get("EVAM_COMPILE_CACHE_DIR", default_cache))
+
     from evam_tpu.engine import steps as step_builders
     from evam_tpu.models.registry import ModelRegistry
 
