@@ -383,16 +383,21 @@ class TestTemporalAccuracy:
         acc.save_fitted(ap, self.AUD, models_dir)
         return models_dir
 
+    def _registry(self, models_dir) -> ModelRegistry:
+        """THE temporal model configuration — every test in this
+        class (pipeline-level and model-level) must exercise the
+        same shapes."""
+        return ModelRegistry(
+            dtype="float32", models_dir=str(models_dir),
+            input_overrides={self.ENC: (48, 48)},
+            width_overrides={self.ENC: 8, self.DEC: 8, self.AUD: 8})
+
     def _hub(self, models_dir):
         from evam_tpu.engine import EngineHub
         from evam_tpu.parallel import build_mesh
 
-        reg = ModelRegistry(
-            dtype="float32", models_dir=str(models_dir),
-            input_overrides={self.ENC: (48, 48)},
-            width_overrides={self.ENC: 8, self.DEC: 8, self.AUD: 8})
-        return EngineHub(reg, plan=build_mesh(), max_batch=16,
-                         deadline_ms=4.0)
+        return EngineHub(self._registry(models_dir), plan=build_mesh(),
+                         max_batch=16, deadline_ms=4.0)
 
     @staticmethod
     def _run(loader, hub, family, variant, params, source):
@@ -444,6 +449,43 @@ class TestTemporalAccuracy:
             assert correct >= 3, f"{correct}/{total} motions recovered"
         finally:
             hub.stop()
+
+    def test_decoder_reads_clip_order(self, fitted_temporal):
+        """Order-sensitivity control at the EMBEDDING level: permuting
+        the 16 frame embeddings into the decoder must be able to
+        change its answer. An order-blind decoder (ignoring its
+        positional embedding) is permutation-invariant by
+        construction, so ANY argmax change under permutation proves
+        the clip axis carries order — without feeding the model
+        off-distribution pixel clips."""
+        from evam_tpu.engine.steps import (
+            build_action_decode_step,
+            build_action_encode_step,
+        )
+
+        reg = self._registry(fitted_temporal)
+        enc, dec = reg.get(self.ENC), reg.get(self.DEC)
+        assert enc.weight_source == "msgpack"
+        enc_step = build_action_encode_step(enc, wire_format="bgr")
+        dec_step = build_action_decode_step(dec)
+
+        rng = np.random.default_rng(11)
+        clip = acc.render_temporal_clip(rng, 0, (48, 48), 16)
+        emb = np.asarray(enc_step(enc.params, clip))       # [16, D]
+        ordered = int(np.asarray(
+            dec_step(dec.params, emb[None])[0]).argmax())
+
+        changed = False
+        for seed in range(8):
+            perm = np.random.default_rng(seed).permutation(16)
+            got = int(np.asarray(
+                dec_step(dec.params, emb[perm][None])[0]).argmax())
+            if got != ordered:
+                changed = True
+                break
+        assert changed, (
+            "decoder output is permutation-invariant — the clip "
+            "axis carries no order (positional embedding unused)")
 
     def test_audio_window_path_recovers_tones(self, fitted_temporal):
         from pathlib import Path
