@@ -35,6 +35,11 @@ SEED = 99
 CLS_KEY = "object_classification/vehicle_attributes"
 CLS_INPUT = (48, 48)
 CLS_WIDTH = 16
+ENC_KEY = "action_recognition/encoder"
+DEC_KEY = "action_recognition/decoder"
+AUD_KEY = "audio_detection/environment"
+ENC_INPUT = (48, 48)
+TEMPORAL_WIDTH = 8
 #: cache keyed on the fit config — stale weights from an older
 #: KEY/INPUT/WIDTH can't poison a new run
 FIT_PATH = Path(
@@ -46,6 +51,13 @@ FIT_ATTR_PATH = FIT_PATH.with_suffix(".attr.msgpack")
 CLS_FIT_PATH = Path(
     f"/tmp/evam_acc_fit_{CLS_KEY.replace('/', '_')}"
     f"_{CLS_INPUT[0]}x{CLS_INPUT[1]}_w{CLS_WIDTH}.msgpack")
+#: temporal families (action enc+dec, aclnet) — one cache file each
+ENC_FIT_PATH = Path(
+    f"/tmp/evam_acc_fit_action_enc_{ENC_INPUT[0]}x{ENC_INPUT[1]}"
+    f"_w{TEMPORAL_WIDTH}.msgpack")
+DEC_FIT_PATH = ENC_FIT_PATH.with_suffix(".dec.msgpack")
+AUD_FIT_PATH = Path(
+    f"/tmp/evam_acc_fit_aclnet_w{TEMPORAL_WIDTH}.msgpack")
 
 
 def _build():
@@ -65,6 +77,18 @@ def _build_cls():
         width_overrides={CLS_KEY: CLS_WIDTH},
         allow_random_weights=True)
     return reg.get(CLS_KEY)
+
+
+def _build_temporal():
+    from evam_tpu.models.registry import ModelRegistry
+
+    reg = ModelRegistry(
+        dtype="float32", input_overrides={ENC_KEY: ENC_INPUT},
+        width_overrides={ENC_KEY: TEMPORAL_WIDTH,
+                         DEC_KEY: TEMPORAL_WIDTH,
+                         AUD_KEY: TEMPORAL_WIDTH},
+        allow_random_weights=True)
+    return reg.get(ENC_KEY), reg.get(DEC_KEY), reg.get(AUD_KEY)
 
 
 def run_fit() -> int:
@@ -117,11 +141,41 @@ def run_fit_classify() -> int:
     return 0
 
 
+def run_fit_temporal() -> int:
+    """CPU-pinned subprocess: action enc+dec and aclnet fits."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from flax import serialization
+
+    from evam_tpu.models import accuracy as acc
+
+    enc, dec, aud = _build_temporal()
+    (ep, dp), hist = acc.fit_action(enc, dec)
+    ap, ahist = acc.fit_audio(aud)
+    print(json.dumps({"action_loss": hist[-1],
+                      "audio_loss": ahist[-1]}), file=sys.stderr)
+    if hist[-1] >= 0.6 or ahist[-1] >= 0.3:
+        print("temporal fits did not converge; not caching",
+              file=sys.stderr)
+        return 3
+    ENC_FIT_PATH.write_bytes(serialization.to_bytes(
+        jax.tree.map(np.asarray, ep)))
+    DEC_FIT_PATH.write_bytes(serialization.to_bytes(
+        jax.tree.map(np.asarray, dp)))
+    AUD_FIT_PATH.write_bytes(serialization.to_bytes(
+        jax.tree.map(np.asarray, ap)))
+    return 0
+
+
 def main() -> int:
     if "--fit" in sys.argv:
         return run_fit()
     if "--fit-classify" in sys.argv:
         return run_fit_classify()
+    if "--fit-temporal" in sys.argv:
+        return run_fit_temporal()
 
     if not FIT_PATH.exists():
         env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -150,6 +204,20 @@ def main() -> int:
             # classify phase is additive (detect still reports), but
             # an attempted-and-failed fit must be visible in the line
             attr_error = f"fit-classify failed rc={crc}"
+    temporal_error = None
+    if not (ENC_FIT_PATH.exists() and DEC_FIT_PATH.exists()
+            and AUD_FIT_PATH.exists()):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        try:
+            trc = subprocess.run(
+                [sys.executable, __file__, "--fit-temporal"], env=env,
+                timeout=900).returncode
+        except subprocess.TimeoutExpired:
+            trc = -9
+        if trc != 0 or not (ENC_FIT_PATH.exists()
+                            and DEC_FIT_PATH.exists()
+                            and AUD_FIT_PATH.exists()):
+            temporal_error = f"fit-temporal failed rc={trc}"
 
     import jax
 
@@ -231,6 +299,57 @@ def main() -> int:
         line["attr_gt"] = attr_report["gt"]
     elif attr_error is not None:
         line["attr_error"] = attr_error
+
+    # temporal families on device: action clip classes + audio tones
+    if (ENC_FIT_PATH.exists() and DEC_FIT_PATH.exists()
+            and AUD_FIT_PATH.exists()):
+        from evam_tpu.engine.steps import (
+            build_action_decode_step,
+            build_action_encode_step,
+            build_audio_step,
+        )
+
+        enc, dec_m, aud = _build_temporal()
+        ep = serialization.from_bytes(
+            enc.params, ENC_FIT_PATH.read_bytes())
+        dp = serialization.from_bytes(
+            dec_m.params, DEC_FIT_PATH.read_bytes())
+        ap = serialization.from_bytes(
+            aud.params, AUD_FIT_PATH.read_bytes())
+        enc_step = jax.jit(build_action_encode_step(
+            enc, wire_format="bgr"))
+        dec_step = jax.jit(build_action_decode_step(dec_m))
+        rng3 = np.random.default_rng(21)
+        classes = [i % 4 for i in range(8)]
+        clips = np.stack([
+            acc.render_temporal_clip(rng3, c, ENC_INPUT, 16)
+            for c in classes])                    # [8, 16, H, W, 3]
+        ep_d = jax.device_put(ep, dev)
+        dp_d = jax.device_put(dp, dev)
+        flat = clips.reshape((-1,) + clips.shape[2:])
+        emb = enc_step(ep_d, jax.device_put(flat, dev))
+        emb = np.asarray(emb).reshape(8, 16, -1)
+        aprobs = np.asarray(dec_step(dp_d, jax.device_put(emb, dev)))
+        line["action_acc"] = float(
+            (aprobs.argmax(axis=1) == np.asarray(classes)).mean())
+
+        audio_step = jax.jit(build_audio_step(aud))
+        rng4 = np.random.default_rng(22)
+        n_samples = aud.spec.input_size[1]  # aclnet window (matches
+        # fit_audio's sizing — no duplicated constant)
+        wins = []
+        tones = []
+        for i in range(8):
+            t = i % 4
+            tones.append(t)
+            wins.append(acc.render_tone_window(rng4, t, n_samples))
+        probs = np.asarray(audio_step(
+            jax.device_put(ap, dev),
+            jax.device_put(np.stack(wins), dev)))
+        line["audio_acc"] = float(
+            (probs.argmax(axis=1) == np.asarray(tones)).mean())
+    elif temporal_error is not None:
+        line["temporal_error"] = temporal_error
 
     print(json.dumps(line))
     return 0 if report["recall"] >= 0.75 else 1
