@@ -78,6 +78,25 @@ def _recovered(dets, scene, iou=0.5):
     return hits, len(scene.boxes)
 
 
+def _run_pipeline_spec(loader, hub, family, variant, params, source):
+    """Resolve a pipeline spec and drive it through StreamRunner,
+    collecting published metadata — THE serving-chain harness every
+    pipeline-level accuracy test shares."""
+    from evam_tpu.graph import resolve_parameters
+    from evam_tpu.stages import StreamRunner, build_stages
+
+    spec = loader.get(family, variant)
+    stages_spec, _ = resolve_parameters(spec, params)
+    outputs = []
+    runner = StreamRunner(
+        "acc", build_stages(
+            stages_spec, hub, source_uri="synthetic://acc",
+            publish_fn=lambda ctx: outputs.append(ctx.metadata)),
+        source_uri="synthetic://acc")
+    runner.run(source)
+    return outputs
+
+
 def test_wire_path_recovers_ground_truth(fitted):
     """1080p BGR → i420 wire → fused preprocess+SSD+NMS (one XLA
     program) → packed rows match ground truth."""
@@ -401,19 +420,8 @@ class TestTemporalAccuracy:
 
     @staticmethod
     def _run(loader, hub, family, variant, params, source):
-        from evam_tpu.graph import resolve_parameters
-        from evam_tpu.stages import StreamRunner, build_stages
-
-        spec = loader.get(family, variant)
-        stages_spec, _ = resolve_parameters(spec, params)
-        outputs = []
-        runner = StreamRunner(
-            "acc", build_stages(
-                stages_spec, hub, source_uri="synthetic://acc",
-                publish_fn=lambda ctx: outputs.append(ctx.metadata)),
-            source_uri="synthetic://acc")
-        runner.run(source)
-        return outputs
+        return _run_pipeline_spec(
+            loader, hub, family, variant, params, source)
 
     def test_action_clip_path_recovers_motion(self, fitted_temporal):
         from pathlib import Path
@@ -567,10 +575,9 @@ class TestTrackingAccuracy:
         from pathlib import Path
 
         from evam_tpu.engine import EngineHub
-        from evam_tpu.graph import PipelineLoader, resolve_parameters
+        from evam_tpu.graph import PipelineLoader
         from evam_tpu.media.source import FrameEvent
         from evam_tpu.parallel import build_mesh
-        from evam_tpu.stages import StreamRunner, build_stages
 
         models_dir, _, _ = fitted
         reg = ModelRegistry(dtype="float32", models_dir=str(models_dir),
@@ -581,19 +588,6 @@ class TestTrackingAccuracy:
         repo = Path(__file__).resolve().parent.parent
         loader = PipelineLoader(repo / "pipelines")
         try:
-            spec = loader.get("object_tracking", "object_line_crossing")
-            stages_spec, _ = resolve_parameters(spec, {
-                "threshold": 0.3,
-                "object-line-crossing-config": {"lines": [{
-                    "name": "midline",
-                    "line": [[0.5, 0.0], [0.5, 1.0]]}]},
-            })
-            outputs = []
-            runner = StreamRunner(
-                "track-acc", build_stages(
-                    stages_spec, hub, source_uri="synthetic://track",
-                    publish_fn=lambda ctx: outputs.append(ctx.metadata)),
-                source_uri="synthetic://track")
             frames, gt_boxes = self._moving_vehicle_frames()
 
             def events():
@@ -601,7 +595,14 @@ class TestTrackingAccuracy:
                     yield FrameEvent(frame=f, pts_ns=i * 33_000_000,
                                      seq=i)
 
-            runner.run(events())
+            outputs = _run_pipeline_spec(
+                loader, hub, "object_tracking", "object_line_crossing",
+                {
+                    "threshold": 0.3,
+                    "object-line-crossing-config": {"lines": [{
+                        "name": "midline",
+                        "line": [[0.5, 0.0], [0.5, 1.0]]}]},
+                }, events())
             assert len(outputs) == len(frames)
 
             # (a) the moving vehicle is detected and keeps ONE id
@@ -805,3 +806,60 @@ class TestEiiAccuracy:
             recovered += best
             total_gt += 1
         assert recovered / total_gt >= 0.6, (recovered, total_gt)
+
+
+class TestZoneCountAccuracy:
+    """Ground truth for the zone-count UDF through the serving chain:
+    with a zone covering the left half of the frame, the published
+    zone-count must equal the number of GT objects whose box lies in
+    (or intersects) that half — per scene, with the fitted detector."""
+
+    def test_zone_count_matches_ground_truth(self, fitted):
+        from pathlib import Path
+
+        from evam_tpu.engine import EngineHub
+        from evam_tpu.graph import PipelineLoader
+        from evam_tpu.media.source import FrameEvent
+        from evam_tpu.parallel import build_mesh
+
+        models_dir, _, _ = fitted
+        reg = ModelRegistry(dtype="float32", models_dir=str(models_dir),
+                            input_overrides={KEY: INPUT},
+                            width_overrides={KEY: WIDTH})
+        hub = EngineHub(reg, plan=build_mesh(), max_batch=16,
+                        deadline_ms=4.0)
+        repo = Path(__file__).resolve().parent.parent
+        loader = PipelineLoader(repo / "pipelines")
+        try:
+            scenes = _holdout_scenes(n=8, seed=321)
+
+            def events():
+                for i, s in enumerate(scenes):
+                    yield FrameEvent(frame=s.frame,
+                                     pts_ns=i * 33_000_000, seq=i)
+
+            outputs = _run_pipeline_spec(
+                loader, hub, "object_detection", "object_zone_count",
+                {
+                    "threshold": 0.3,
+                    "object-zone-count-config": {"zones": [{
+                        "name": "left-half",
+                        "polygon": [[0.0, 0.0], [0.5, 0.0],
+                                    [0.5, 1.0], [0.0, 1.0]]}]},
+                }, events())
+            assert len(outputs) == len(scenes)
+
+            agree = 0
+            for s, m in zip(scenes, outputs):
+                # GT: objects whose box touches x < 0.5 at all
+                gt_count = int(sum(b[0] < 0.5 for b in s.boxes))
+                evs = [e for e in m.get("events", [])
+                       if e["event-type"] == "zone-count"]
+                got = evs[0]["zone-count"] if evs else 0
+                agree += got == gt_count
+            # detection recall ~0.85 bounds agreement; a geometry bug
+            # (wrong polygon test, swapped axes) would zero it
+            assert agree >= 0.6 * len(scenes), (
+                f"zone counts agreed on {agree}/{len(scenes)} scenes")
+        finally:
+            hub.stop()
