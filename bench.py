@@ -419,6 +419,14 @@ def main() -> int:
         help="[serve] engine stall watchdog (s); lower it on a "
              "wedge-prone tunnel so a hung device call fails the "
              "entry fast instead of burning the window")
+    p.add_argument(
+        "--serialize-compile", action="store_true",
+        help="[serve] wedge-proof mode: set EVAM_SERIALIZE_COMPILE=1 "
+             "so every engine device call (launch/compile/readback) "
+             "runs under one process-wide lock — no compile can race "
+             "a dispatch RPC (the r4 wedge suspect). Costs "
+             "double-buffering; use for the first serve entry of a "
+             "battery so a wedge can never eat the record")
     p.add_argument("--deadline-ms", type=float, default=8.0,
                    help="[serve] engine batch-fill deadline")
     p.add_argument(
@@ -461,6 +469,9 @@ def main() -> int:
     args = p.parse_args()
 
     import os
+
+    if args.serialize_compile:
+        os.environ["EVAM_SERIALIZE_COMPILE"] = "1"
 
     metric_name = _metric_for(args.config)
 

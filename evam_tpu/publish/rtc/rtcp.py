@@ -107,6 +107,10 @@ class SrtcpReceiver:
     using the 31-bit index carried in the trailer.
     """
 
+    #: replay window width (packets behind the highest-seen index
+    #: still accepted exactly once) — RFC 3711 recommends >= 64
+    REPLAY_WINDOW = 64
+
     def __init__(self, master_key: bytes, master_salt: bytes):
         self.cipher_key, self.auth_key, self.salt = srtp.derive_keys(
             master_key, master_salt,
@@ -114,13 +118,40 @@ class SrtcpReceiver:
                     SrtcpSender.LABEL_RTCP_AUTH,
                     SrtcpSender.LABEL_RTCP_SALT),
         )
+        self._highest_index = -1     # highest authenticated SRTCP index
+        self._replay_mask = 0        # bit i = (highest - i) seen
+
+    def _replay_check(self, index: int) -> None:
+        """RFC 3711 §3.3.2 replay list over the 31-bit SRTCP index:
+        a captured valid compound (e.g. one NACK re-triggering a
+        512-packet retransmit burst) must not be accepted twice."""
+        if index > self._highest_index:
+            return
+        delta = self._highest_index - index
+        if delta >= self.REPLAY_WINDOW or (self._replay_mask >> delta) & 1:
+            raise ValueError("SRTCP replay: index %d already seen" % index)
+
+    def _replay_commit(self, index: int) -> None:
+        if index > self._highest_index:
+            shift = index - self._highest_index
+            # cap the shift: a far jump (peer restart, index desync)
+            # must not materialize a 2^31-bit intermediate
+            if shift >= self.REPLAY_WINDOW:
+                self._replay_mask = 1
+            else:
+                self._replay_mask = ((self._replay_mask << shift) | 1) \
+                    & ((1 << self.REPLAY_WINDOW) - 1)
+            self._highest_index = index
+        else:
+            self._replay_mask |= 1 << (self._highest_index - index)
 
     def unprotect(self, pkt: bytes) -> bytes:
         """SRTCP packet in → plaintext RTCP compound out.
 
-        Raises ``ValueError`` on a bad tag or a malformed packet —
-        callers drop the packet (never act on unauthenticated
-        feedback: a forged NACK burst is a retransmission-amplifier).
+        Raises ``ValueError`` on a bad tag, a malformed packet, or a
+        replayed SRTCP index — callers drop the packet (never act on
+        unauthenticated or replayed feedback: a forged or replayed
+        NACK burst is a retransmission-amplifier).
         """
         if len(pkt) < 8 + 4 + srtp.TAG_LEN:
             raise ValueError("short SRTCP packet")
@@ -132,6 +163,8 @@ class SrtcpReceiver:
             raise ValueError("SRTCP auth tag mismatch")
         trailer = struct.unpack("!I", body[-4:])[0]
         e_bit, index = trailer >> 31, trailer & 0x7FFFFFFF
+        self._replay_check(index)
+        self._replay_commit(index)
         enc = body[:-4]
         if not e_bit:
             return enc                        # unencrypted RTCP
@@ -150,13 +183,17 @@ PT_RTPFB = 205   # transport-layer feedback (FMT 1 = Generic NACK)
 PT_PSFB = 206    # payload-specific feedback (FMT 1 = PLI, 4 = FIR)
 
 
-def parse_feedback(compound: bytes) -> dict:
+def parse_feedback(compound: bytes, media_ssrc: int | None = None) -> dict:
     """Walk a plaintext RTCP compound and pull out what the sender
     acts on: ``{"nack": [seq…], "pli": bool, "fir": bool,
     "fraction_lost": float|None, "highest_seq": int|None}``.
 
     NACK FCI entries are (PID, BLP) pairs (RFC 4585 §6.2.1): PID is a
     lost packet, each set bit i of BLP marks PID+i+1 lost too.
+
+    ``media_ssrc`` (when given) drops feedback messages addressed to
+    a different media source — an authenticated peer must not steer
+    retransmission/keyframes for an SSRC it is not receiving.
     """
     out: dict = {"nack": [], "pli": False, "fir": False,
                  "fraction_lost": None, "highest_seq": None}
@@ -169,22 +206,47 @@ def parse_feedback(compound: bytes) -> dict:
         fmt = first & 0x1F                   # RC for SR/RR, FMT for FB
         end = i + 4 * (length_w + 1)
         body = compound[i + 8:end]           # after header + sender-ssrc
+        want = None if media_ssrc is None else media_ssrc & 0xFFFFFFFF
         if pt == PT_RR and fmt >= 1 and len(body) >= 24:
-            # first report block: fraction_lost + ext highest seq
-            out["fraction_lost"] = body[4] / 256.0
-            out["highest_seq"] = struct.unpack("!I", body[8:12])[0]
-        elif pt == PT_RTPFB and fmt == 1:
-            fci = body[4:]                   # skip media-ssrc
-            for j in range(0, len(fci) - 3, 4):
-                pid, blp = struct.unpack("!HH", fci[j:j + 4])
-                out["nack"].append(pid)
-                for bit in range(16):
-                    if blp & (1 << bit):
-                        out["nack"].append((pid + bit + 1) & 0xFFFF)
-        elif pt == PT_PSFB and fmt == 1:
-            out["pli"] = True
-        elif pt == PT_PSFB and fmt == 4:
-            out["fir"] = True
+            # walk all RC report blocks (24 bytes each) and use the
+            # one ABOUT our source — a viewer receiving several
+            # streams reports them all in one RR, in any order (the
+            # loss path forces keyframes; see _handle_feedback)
+            for j in range(0, min(fmt, len(body) // 24) * 24, 24):
+                block_ssrc = struct.unpack("!I", body[j:j + 4])[0]
+                if want is None or block_ssrc == want:
+                    out["fraction_lost"] = body[j + 4] / 256.0
+                    out["highest_seq"] = struct.unpack(
+                        "!I", body[j + 8:j + 12])[0]
+                    break
+        elif pt in (PT_RTPFB, PT_PSFB) and len(body) >= 4:
+            fb_media = struct.unpack("!I", body[:4])[0]
+            if pt == PT_PSFB and fmt == 4:
+                # FIR (RFC 5104 §4.3.1.1): the header media-SSRC
+                # SHALL be 0 — the target SSRC rides in each 8-byte
+                # FCI entry. Accept header==want for lenient senders.
+                fci_ssrcs = [
+                    struct.unpack("!I", body[4 + j:8 + j])[0]
+                    for j in range(0, max(0, len(body) - 4 - 7), 8)
+                ]
+                if (want is None or fb_media == want
+                        or want in fci_ssrcs):
+                    out["fir"] = True
+                i = end
+                continue
+            if want is not None and fb_media != want:
+                i = end
+                continue                     # feedback for another source
+            if pt == PT_RTPFB and fmt == 1:
+                fci = body[4:]
+                for j in range(0, len(fci) - 3, 4):
+                    pid, blp = struct.unpack("!HH", fci[j:j + 4])
+                    out["nack"].append(pid)
+                    for bit in range(16):
+                        if blp & (1 << bit):
+                            out["nack"].append((pid + bit + 1) & 0xFFFF)
+            elif pt == PT_PSFB and fmt == 1:
+                out["pli"] = True
         i = end
     return out
 

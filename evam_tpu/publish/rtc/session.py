@@ -342,7 +342,7 @@ class RtcSession:
             plain = self._srtcp_rx.unprotect(data)
         except ValueError:
             return
-        fb = rtcp.parse_feedback(plain)
+        fb = rtcp.parse_feedback(plain, media_ssrc=self.ssrc)
         if fb["nack"]:
             self.nacks_received += 1
             for seq in fb["nack"]:

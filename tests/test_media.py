@@ -391,3 +391,65 @@ def test_pool_churn_add_close_stop_race():
     assert all(not t.is_alive() for t in dthreads), "drain hung on stop"
     assert len(got_eos) == 4
     assert all(s.finished for s in live)
+
+
+class TestH264Generator:
+    """The intra-only Annex-B generator (media/h264.py) — VERDICT r4
+    item 4: genuine H.264 input for the decode benches, hand-built
+    because no H.264 encoder ships in this image."""
+
+    def test_ffmpeg_decodes_and_roundtrips(self, tmp_path):
+        import cv2
+
+        from evam_tpu.media import h264
+
+        frames = []
+        for i in range(4):
+            f = np.zeros((96, 128, 3), np.uint8)
+            f[:, :] = (40, 90, 160)
+            f[20:60, 30 + 10 * i:70 + 10 * i] = (200, 60, 30)
+            frames.append(f)
+        path = str(tmp_path / "clip.h264")
+        h264.write_annexb(path, frames)
+        cap = cv2.VideoCapture(path)
+        n = 0
+        while True:
+            ok, img = cap.read()
+            if not ok:
+                break
+            assert img.shape == (96, 128, 3)
+            err = float(np.abs(img.astype(int)
+                               - frames[n].astype(int)).mean())
+            # chroma-smooth content: residual is the BT.601 studio- vs
+            # full-swing convention gap plus rounding, not codec loss
+            assert err < 4.0, (n, err)
+            n += 1
+        assert n == 4
+
+    def test_non_multiple_of_16_is_cropped(self, tmp_path):
+        """True 1080-style sizes: coded height pads to 16, SPS crop
+        carves the real picture back out (how every encoder ships
+        1080p)."""
+        import cv2
+
+        from evam_tpu.media import h264
+
+        f = np.full((120, 64, 3), 90, np.uint8)  # 120 = 7.5 MBs high
+        path = str(tmp_path / "crop.h264")
+        h264.write_annexb(path, [f])
+        cap = cv2.VideoCapture(path)
+        ok, img = cap.read()
+        assert ok and img.shape == (120, 64, 3)
+
+    def test_file_source_reads_annexb(self, tmp_path):
+        """The serving ingest path (FileSource → cv2/FFmpeg) consumes
+        the elementary stream directly."""
+        from evam_tpu.media import h264
+
+        frames = [np.full((64, 64, 3), 30 * i, np.uint8)
+                  for i in range(3)]
+        path = str(tmp_path / "src.h264")
+        h264.write_annexb(path, frames)
+        events = list(FileSource(path).frames())
+        assert len(events) == 3
+        assert events[0].frame.shape == (64, 64, 3)

@@ -686,6 +686,87 @@ class TestRtcpFeedback:
         assert abs(fb["fraction_lost"] - 0.25) < 1 / 256
         assert fb["highest_seq"] == 5000
 
+    def test_media_ssrc_filter(self):
+        """Authenticated feedback addressed to a DIFFERENT media
+        source must not steer retransmission/keyframes (ADVICE r4):
+        NACK/PLI header media-SSRC and the RR report-block SSRC are
+        all checked against the session SSRC."""
+        from evam_tpu.publish.rtc import rtcp
+
+        ours, theirs = 0xBB, 0xDD
+        fb = rtcp.parse_feedback(
+            rtcp.generic_nack(1, theirs, [7]) + rtcp.pli(1, theirs),
+            media_ssrc=ours)
+        assert fb["nack"] == [] and not fb["pli"]
+        fb = rtcp.parse_feedback(
+            rtcp.receiver_report(1, theirs, fraction_lost=0.9,
+                                 cumulative_lost=9, highest_seq=100),
+            media_ssrc=ours)
+        assert fb["fraction_lost"] is None  # cross-SSRC loss ignored
+        # matching SSRC still flows
+        fb = rtcp.parse_feedback(
+            rtcp.generic_nack(1, ours, [7]) + rtcp.pli(1, ours),
+            media_ssrc=ours)
+        assert fb["nack"] == [7] and fb["pli"]
+
+    def test_rr_uses_block_about_our_ssrc_not_first(self):
+        """A viewer receiving several streams reports them all in one
+        RR — the block about OUR source must be found wherever it
+        sits, not only first."""
+        import struct
+
+        from evam_tpu.publish.rtc import rtcp
+
+        ours, other = 0xBB, 0xDD
+        blocks = b""
+        for ssrc, fl in ((other, 10), (ours, 64)):
+            blocks += struct.pack(
+                "!IBBHIIII", ssrc, fl, 0, 0, 4000, 0, 0, 0)
+        rr = struct.pack("!BBHI", 0x80 | 2, rtcp.PT_RR,
+                         1 + len(blocks) // 4, 1) + blocks
+        fb = rtcp.parse_feedback(rr, media_ssrc=ours)
+        assert abs(fb["fraction_lost"] - 64 / 256) < 1e-9
+        assert fb["highest_seq"] == 4000
+
+    def test_fir_spec_compliant_zero_header_ssrc(self):
+        """RFC 5104 §4.3.1.1: FIR's header media-SSRC SHALL be 0 —
+        the target rides in the 8-byte FCI entries. A compliant
+        libwebrtc FIR must pass the session-SSRC filter."""
+        import struct
+
+        from evam_tpu.publish.rtc import rtcp
+
+        ours = 0xBB
+        fci = struct.pack("!IBBH", ours, 1, 0, 0)  # target, seq, rsvd
+        fir = struct.pack("!BBHII", 0x80 | 4, rtcp.PT_PSFB,
+                          2 + len(fci) // 4, 1, 0) + fci
+        assert rtcp.parse_feedback(fir, media_ssrc=ours)["fir"]
+        # a FIR targeting a different SSRC is dropped
+        fci2 = struct.pack("!IBBH", 0xDD, 1, 0, 0)
+        fir2 = struct.pack("!BBHII", 0x80 | 4, rtcp.PT_PSFB,
+                           2 + len(fci2) // 4, 1, 0) + fci2
+        assert not rtcp.parse_feedback(fir2, media_ssrc=ours)["fir"]
+
+    def test_srtcp_replay_rejected(self):
+        """RFC 3711 §3.3.2: a captured valid compound replayed
+        verbatim must be rejected (one NACK re-triggering the send
+        cache is a retransmission amplifier — ADVICE r4)."""
+        import pytest
+
+        from evam_tpu.publish.rtc import rtcp
+
+        key, salt = b"K" * 16, b"S" * 14
+        tx = rtcp.SrtcpSender(key, salt)
+        rx = rtcp.SrtcpReceiver(key, salt)
+        p1 = tx.protect(rtcp.generic_nack(0xAA, 0xBB, [1]))
+        p2 = tx.protect(rtcp.generic_nack(0xAA, 0xBB, [2]))
+        assert rx.unprotect(p1)
+        assert rx.unprotect(p2)          # in order: fine
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect(p1)             # verbatim replay: rejected
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect(p2)
+
     def test_srtcp_receiver_roundtrip_and_tamper(self):
         import pytest
 
@@ -952,6 +1033,11 @@ class TestLossRecovery:
             assert viewer.seqs().count(lost_seq) > count_before, \
                 "NACKed packet was not retransmitted"
             assert sess.nacks_received == 1
+            # the feedback thread increments the counter AFTER the
+            # sendto the viewer just observed — give it a beat
+            deadline = time.time() + 2
+            while time.time() < deadline and not sess.packets_retransmitted:
+                time.sleep(0.01)
             assert sess.packets_retransmitted >= 1
 
             # --- PLI: picture loss forces an immediate keyframe

@@ -90,6 +90,9 @@ class TestRoutes:
         assert "object_detection/person_vehicle_bike" in rows
         # hermetic test env: provenance must say so, not pretend
         assert rows["object_detection/person_vehicle_bike"] == "random"
+        # the gate rides every row (VERDICT r4 item 7): "random" is
+        # only servable because EVAM_ALLOW_RANDOM_WEIGHTS permits it
+        assert all(d["allow_random_weights"] is True for d in data)
 
     def test_healthz_and_metrics(self, registry):
         status, data = _request(registry, "GET", "/healthz")
@@ -142,6 +145,16 @@ class TestInstanceLifecycle:
         assert status == 200
         assert data["state"] == "COMPLETED"
         assert data["id"] == iid
+        # per-engine weight provenance in the status payload (VERDICT
+        # r4 item 7): the hermetic env serves random-init weights and
+        # the consumer must be able to see that
+        assert "weights" in data
+        stage_rows = list(data["weights"].values())
+        assert stage_rows, "no inference stage reported provenance"
+        assert all(
+            src == "random"
+            for row in stage_rows for src in row["weights"].values()
+        )
 
         lines = [json.loads(l) for l in out_file.read_text().splitlines()]
         assert len(lines) == 6

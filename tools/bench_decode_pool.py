@@ -41,14 +41,23 @@ sys.path.insert(0, str(REPO))
 from tools.measure_decode import busy_frames  # noqa: E402
 
 
-def make_clip(n_frames: int) -> str:
+def make_clip(n_frames: int, codec: str = "mp4v") -> str:
     import cv2
 
+    if codec == "h264":
+        # genuine H.264 via the from-scratch intra-only generator
+        # (media/h264.py) — I_PCM, so a lower bound on camera-grade
+        # H.264 decode cost, but through FFmpeg's real H.264 path
+        from evam_tpu.media import h264
+
+        path = str(Path(tempfile.gettempdir()) / "pool_bench.h264")
+        h264.write_annexb(path, list(busy_frames(n_frames)))
+        return path
     path = str(Path(tempfile.gettempdir()) / "pool_bench.mp4")
     wr = cv2.VideoWriter(
-        path, cv2.VideoWriter_fourcc(*"mp4v"), 30, (1920, 1080))
+        path, cv2.VideoWriter_fourcc(*codec), 30, (1920, 1080))
     if not wr.isOpened():
-        raise RuntimeError("mp4v encoder unavailable")
+        raise RuntimeError(f"{codec} encoder unavailable")
     for f in busy_frames(n_frames):
         wr.write(f)
     wr.release()
@@ -110,9 +119,12 @@ def main() -> int:
     ap.add_argument("--streams", type=int, default=8)
     ap.add_argument("--pool-workers", type=int, default=1)
     ap.add_argument("--frames", type=int, default=90)
+    ap.add_argument("--codec", default="mp4v",
+                    help="mp4v (default) or h264 (intra-only Annex-B "
+                         "from media/h264.py — real FFmpeg H.264 path)")
     args = ap.parse_args()
 
-    clip = make_clip(args.frames)
+    clip = make_clip(args.frames, args.codec)
     expected = args.frames * args.streams
     # warm the page cache so both runs read hot
     Path(clip).read_bytes()
@@ -124,6 +136,7 @@ def main() -> int:
 
     out = {
         "metric": "decode_pool_efficiency",
+        "codec": args.codec,
         "streams": args.streams,
         "pool_workers": args.pool_workers,
         "frames_per_stream": args.frames,

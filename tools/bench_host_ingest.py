@@ -90,6 +90,36 @@ def main() -> int:
     cap.release()
     report["cv2_mjpeg_decode_fps_1core"] = round(decode_fps, 1)
 
+    # REAL H.264 decode (VERDICT r4 item 4): intra-only Annex-B from
+    # the from-scratch generator — measured through FFmpeg's actual
+    # H.264 slice/MB decode path. I_PCM has no inverse transform or
+    # prediction, so treat this as a LOWER bound on camera-grade
+    # H.264 cost per frame (noted in INGEST.md).
+    from evam_tpu.media import h264 as h264_mod
+
+    h264_clip = "/tmp/ingest_bench_h264.h264"
+    n_h264_frames = len(frames) * 4
+    if not os.path.exists(h264_clip):
+        # atomic: a run killed mid-write must not leave a truncated
+        # clip that every later run silently reuses
+        h264_mod.write_annexb(h264_clip + ".tmp", frames * 4)
+        os.replace(h264_clip + ".tmp", h264_clip)
+    cap = cv2.VideoCapture(h264_clip)
+    n, t0 = 0, time.perf_counter()
+    while True:
+        ok, _ = cap.read()
+        if not ok:
+            break
+        n += 1
+    h264_fps = n / (time.perf_counter() - t0)
+    cap.release()
+    if n != n_h264_frames:       # stale/corrupt cached clip: rebuild
+        os.remove(h264_clip)
+        raise RuntimeError(
+            f"h264 bench clip decoded {n}/{n_h264_frames} frames — "
+            "cached file was corrupt; removed, re-run")
+    report["cv2_h264_ipcm_decode_fps_1core"] = round(h264_fps, 1)
+
     # extrapolation to the 64-stream north star
     need = 64 * 30
     best_prep = max(
@@ -99,6 +129,9 @@ def main() -> int:
     per_frame_s = 1.0 / best_prep + 1.0 / decode_fps
     report["northstar_frames_per_s"] = need
     report["est_cores_for_64x1080p30"] = round(need * per_frame_s, 1)
+    per_frame_h264_s = 1.0 / best_prep + 1.0 / h264_fps
+    report["est_cores_for_64x1080p30_h264"] = round(
+        need * per_frame_h264_s, 1)
     print(json.dumps(report, indent=2))
     return 0
 

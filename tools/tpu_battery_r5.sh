@@ -1,0 +1,86 @@
+#!/bin/bash
+# Round-5 battery — the evidence round (VERDICT r4 items 1+2).
+# Ordering doctrine (PROFILE.md r3/r4 wedge history):
+#   1. cheapest headline first (re-establish the record),
+#   2. every quick measurement-debt entry next,
+#   3. the serve family: serve_safe FIRST with --serialize-compile
+#      (wedge-proof mode: global compile/execute lock + preload-first
+#      — banks the first-ever serve-path TPU number even if later
+#      entries wedge), then the unserialized serve entries (tests
+#      whether preload-first alone holds),
+#   4. tools/wedge_repro.py DEAD LAST: it deliberately recreates the
+#      suspected wedge condition (background compiles racing steady
+#      dispatch). If it wedges after serve survived, the hypothesis
+#      is confirmed and the defense validated; nothing is lost.
+# Arm with:
+#   bash tools/tpu_watch.sh tools/tpu_battery_r5.sh /tmp/tpu_battery_r5 43200 BENCH_SERVE_r05.json
+set -u
+OUT=${1:-/tmp/tpu_battery_r5}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+FAILED=0
+run() {
+    name=$1; hard_timeout=$2; shift 2
+    echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+    timeout "$hard_timeout" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+    local rc=$?
+    echo "rc=$rc $(tail -1 "$OUT/$name.json" 2>/dev/null)" | tee -a "$OUT/battery.log"
+    [ $rc -ne 0 ] && FAILED=$((FAILED + 1))
+    python tools/fold_battery2.py "$OUT" BENCH_SERVE_r05.json \
+        > "$OUT/folded.md" 2>>"$OUT/watch.log" || true
+    return $rc
+}
+
+# 1 ---- re-establish the headline cheaply
+run default 600 python bench.py --seconds 12
+
+# 2 ---- the measurement debt (r3 item 2, third ask): minutes each
+run blocking 600 python tools/verify_blocking.py
+run action 600 python bench.py --config action --seconds 8
+run audio 600 python bench.py --config audio --seconds 8
+run budget 900 python tools/profile_budget.py
+run sweep40 900 python bench.py --sweep --seconds 40 --p99-target-ms 40
+if [ -e tools/accuracy_device.py ]; then
+    run accuracy 900 python tools/accuracy_device.py
+fi
+
+# ---- IR-backed detect (models synthesized once, reused)
+IRDIR=$OUT/omz_models
+if [ ! -d "$IRDIR" ]; then
+    rm -rf "$IRDIR.tmp"
+    if timeout 900 python -m evam_tpu.cli.main fetch-models \
+        --synthesize-omz all --topology manifest --output "$IRDIR.tmp" \
+        >"$OUT/fetch.log" 2>&1; then
+        mv "$IRDIR.tmp" "$IRDIR"
+    fi
+fi
+run detect_ir 600 python bench.py --config detect --models-dir "$IRDIR" --seconds 8
+
+# ---- host-ingest point
+run host 600 python bench.py --ingest host --batch 8 --depth 2 --seconds 6
+
+# 3 ---- THE serve family (r4 item 1, final ask). serve_safe first:
+# both defenses on, banks the number; plain serve second: preload-
+# first only (the r4 mitigation hypothesis under test).
+run serve_safe 900 python bench.py --config serve --streams 64 --seconds 24 \
+    --batch 256 --stall-timeout 180 --serialize-compile
+run serve 900 python bench.py --config serve --streams 64 --seconds 24 \
+    --batch 256 --stall-timeout 180
+run serve_b128 700 python bench.py --config serve --streams 64 --seconds 16 \
+    --batch 128 --stall-timeout 180 --serialize-compile
+run serve_file_32 700 python bench.py --config serve --streams 32 --seconds 12 \
+    --batch 256 --serve-publish file --stall-timeout 180 --serialize-compile
+run serve_ir 700 python bench.py --config serve --streams 64 --seconds 16 \
+    --batch 256 --models-dir "$IRDIR" --stall-timeout 180 --serialize-compile
+
+# 4 ---- the deliberate wedge repro, DEAD LAST (may take the tunnel
+# down — that outcome IS the datum). Unserialized on purpose.
+run wedge_repro 600 python tools/wedge_repro.py --seconds 8
+# control: same structure under the lock — if the first repro wedged,
+# this one never runs (the wrapper timeout + wedged tunnel), which the
+# log records; if both run, compare overlap_max.
+run wedge_repro_locked 600 python tools/wedge_repro.py --seconds 8 --serialize
+
+echo "battery r5 complete -> $OUT ($FAILED failed)" | tee -a "$OUT/battery.log"
+exit $((FAILED > 0))

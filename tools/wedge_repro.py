@@ -1,0 +1,118 @@
+"""Minimal repro of the serve-path wedge condition (VERDICT r4 item 2).
+
+The serve path is the only bench configuration where a background
+bucket-warmup COMPILE overlaps steady-state dispatch RPCs — and the
+only one that has ever wedged the axon tunnel (PROFILE.md r3/r4).
+This tool reproduces exactly that client-side structure and nothing
+else: one thread compiling FRESH programs (a new shape each
+iteration → a real compile RPC every time) while the main thread runs
+steady-state dispatch of a pre-warmed program.
+
+Two uses:
+* ``--platform cpu``: demonstrates the overlap is real at the client
+  (``devlock.max_concurrent() > 1``) and that
+  ``EVAM_SERIALIZE_COMPILE=1`` (or ``--serialize``) removes it
+  (``== 1``) — the CPU half of the evidence, also asserted by
+  ``tests/test_engine.py``.
+* on the tunnel (no ``--platform``): the hypothesis test. Run LAST in
+  a battery under ``timeout`` — if this wedges while the serve
+  entries (preload-first + serialize) survived, the overlap
+  hypothesis is confirmed and the defense validated. Progress lines
+  go to stderr every 2 s so a timeout post-mortem shows which phase
+  hung.
+
+Prints ONE JSON line:
+  {"platform": ..., "serialize": bool, "dispatches": N, "compiles": N,
+   "overlap_max": N, "wedged": false, "seconds": S}
+(A wedge never prints — the wrapper timeout is the signal.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None,
+                    help="cpu forces jax off the tunnel (axon hook-safe)")
+    ap.add_argument("--serialize", action="store_true",
+                    help="enable EVAM_SERIALIZE_COMPILE for this run")
+    ap.add_argument("--seconds", type=float, default=8.0)
+    args = ap.parse_args()
+
+    if args.serialize:
+        os.environ["EVAM_SERIALIZE_COMPILE"] = "1"
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.platform:
+        # the image's .axon_site hook rewrites JAX_PLATFORMS at import;
+        # only a post-import config update reliably forces CPU
+        jax.config.update("jax_platforms", args.platform)
+
+    from evam_tpu.engine import devlock
+
+    devlock.reset_stats()
+    progress = {"phase": "warmup", "dispatches": 0, "compiles": 0}
+    stop = threading.Event()
+
+    def monitor() -> None:
+        while not stop.wait(2.0):
+            print(f"[wedge_repro] {progress}", file=sys.stderr, flush=True)
+
+    threading.Thread(target=monitor, daemon=True).start()
+
+    # steady-state program, fully warmed before any overlap starts
+    step = jax.jit(lambda a: (a @ a).sum())
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    step(x).block_until_ready()
+
+    def compile_loop() -> None:
+        # a NEW shape per iteration defeats both the jit cache and the
+        # persistent compile cache → every iteration is a compile RPC
+        n = 0
+        while not stop.is_set():
+            shape = 128 + 8 * (n % 64) + 1  # odd sizes, never repeats mod-cycle
+            fn = jax.jit(lambda a, _n=n: (a @ a).sum() + _n)
+            y = jnp.ones((shape, shape), jnp.bfloat16)
+            with devlock.device_call("repro:compile"):
+                fn(y).block_until_ready()
+            n += 1
+            progress["compiles"] = n
+
+    progress["phase"] = "overlap"
+    t = threading.Thread(target=compile_loop, daemon=True)
+    t.start()
+
+    t0 = time.perf_counter()
+    n_dispatch = 0
+    while time.perf_counter() - t0 < args.seconds:
+        with devlock.device_call("repro:dispatch"):
+            step(x).block_until_ready()
+        n_dispatch += 1
+        progress["dispatches"] = n_dispatch
+    stop.set()
+    t.join(timeout=10)
+    progress["phase"] = "done"
+
+    print(json.dumps({
+        "platform": args.platform or jax.default_backend(),
+        "serialize": devlock.enabled(),
+        "dispatches": n_dispatch,
+        "compiles": progress["compiles"],
+        "overlap_max": devlock.max_concurrent(),
+        "wedged": False,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
