@@ -82,6 +82,12 @@ class Settings(BaseModel):
     #: bounds total decode threads at 64-stream scale
     #: (media/pool.py; VERDICT r3 item 10). 0 = per-stream (default).
     decode_pool_workers: int = 0
+    #: >0 routes rtsp:// sources through the async RtspDemux (one
+    #: selector thread + this many JPEG-decode workers for ALL live
+    #: streams — media/demux.py; VERDICT r4 item 3). 0 = per-stream
+    #: blocking reader via cv2/FFmpeg (default; required for
+    #: non-RFC-2435 camera codecs until RFC 6184 lands).
+    rtsp_demux_workers: int = 0
     tpu: TPUSettings = Field(default_factory=TPUSettings)
 
     @classmethod
@@ -109,6 +115,7 @@ class Settings(BaseModel):
             "EVAM_STATE_DIR": ("state_dir", str),
             "EVAM_PRELOAD": ("preload", str),
             "EVAM_DECODE_POOL_WORKERS": ("decode_pool_workers", int),
+            "EVAM_RTSP_DEMUX_WORKERS": ("rtsp_demux_workers", int),
         }
         for var, (key, conv) in mapping.items():
             if var in env:

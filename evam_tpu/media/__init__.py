@@ -7,9 +7,12 @@ from evam_tpu.media.source import (
     create_source,
 )
 from evam_tpu.media.decode import DecodeWorker
+from evam_tpu.media.demux import DemuxStream, RtspDemux
 from evam_tpu.media.pool import DecodePool, PooledStream
 
 __all__ = [
+    "DemuxStream",
+    "RtspDemux",
     "AppSource",
     "FileSource",
     "FrameEvent",

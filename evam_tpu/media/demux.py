@@ -1,0 +1,695 @@
+"""Async RTSP/RTP demux: N live streams on ONE selector thread.
+
+The decode pool (`media/pool.py`) consolidates *free-running* decode
+but honestly scopes itself away from live sources: under cv2's
+blocking-read model a live RTSP stream pins a reader thread per
+camera, so the 64-live-stream north star (BASELINE.md config 5) meant
+64 threads plus FFmpeg's per-capture teams on the serving host —
+the reference hides the same problem inside GStreamer's bounded
+streaming threads (reference
+pipelines/object_detection/person/pipeline.json:4 `uridecodebin`).
+
+This module removes the per-stream reader by OWNING the socket
+(VERDICT r4 item 3): an RTSP client handshake
+(DESCRIBE/SETUP/PLAY) per stream, then every connection registers
+with one ``selectors`` loop that parses TCP-interleaved RTP
+(RFC 2326 §10.12) and depacketizes RTP/JPEG (RFC 2435) incrementally
+— no thread ever blocks on a socket. Complete JPEG frames are handed
+to a small decode-worker team (cv2.imdecode) that preserves
+per-stream order by servicing at most one frame per stream at a
+time. Total threads for N streams = 1 selector + ``decode_workers``,
+regardless of N.
+
+Scope: RTP/MJPEG over TCP-interleaved transport — the dialect
+``publish/rtsp.py`` speaks, so an evam-tpu deployment can fan its own
+re-streams back in, and any RFC-2435 camera works. H.264 RTP
+depacketization (RFC 6184) would slot into ``_on_rtp`` the same way;
+recorded as future work in INGEST.md.
+
+Consumer contract matches ``PooledStream``: ``frames()`` iterator on
+a bounded queue with live drop-oldest semantics, decoded/dropped
+counters, ``error``/``finished`` terminal state.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import selectors
+import socket
+import struct
+import threading
+from collections import deque
+from urllib.parse import urlparse
+
+import numpy as np
+
+from evam_tpu.media.decode import drop_oldest_put
+from evam_tpu.media.source import FrameEvent
+from evam_tpu.obs import get_logger, metrics
+
+log = get_logger("media.demux")
+
+RTP_CLOCK = 90_000
+
+# ---------------------------------------------------------------- JFIF
+# Standard JPEG Huffman tables (ITU-T T.81 Annex K.3) — RFC 2435
+# streams omit them (every compliant encoder uses these unless it
+# optimizes coding, which cv2/libjpeg does not by default), so the
+# receiver re-emits them when rebuilding a decodable JFIF.
+
+_DC_LUM_BITS = bytes([0, 1, 5, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0])
+_DC_LUM_VALS = bytes(range(12))
+_DC_CHM_BITS = bytes([0, 3, 1, 1, 1, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0])
+_DC_CHM_VALS = bytes(range(12))
+_AC_LUM_BITS = bytes([0, 2, 1, 3, 3, 2, 4, 3, 5, 5, 4, 4, 0, 0, 1, 0x7D])
+_AC_LUM_VALS = bytes([
+    0x01, 0x02, 0x03, 0x00, 0x04, 0x11, 0x05, 0x12,
+    0x21, 0x31, 0x41, 0x06, 0x13, 0x51, 0x61, 0x07,
+    0x22, 0x71, 0x14, 0x32, 0x81, 0x91, 0xA1, 0x08,
+    0x23, 0x42, 0xB1, 0xC1, 0x15, 0x52, 0xD1, 0xF0,
+    0x24, 0x33, 0x62, 0x72, 0x82, 0x09, 0x0A, 0x16,
+    0x17, 0x18, 0x19, 0x1A, 0x25, 0x26, 0x27, 0x28,
+    0x29, 0x2A, 0x34, 0x35, 0x36, 0x37, 0x38, 0x39,
+    0x3A, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48, 0x49,
+    0x4A, 0x53, 0x54, 0x55, 0x56, 0x57, 0x58, 0x59,
+    0x5A, 0x63, 0x64, 0x65, 0x66, 0x67, 0x68, 0x69,
+    0x6A, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78, 0x79,
+    0x7A, 0x83, 0x84, 0x85, 0x86, 0x87, 0x88, 0x89,
+    0x8A, 0x92, 0x93, 0x94, 0x95, 0x96, 0x97, 0x98,
+    0x99, 0x9A, 0xA2, 0xA3, 0xA4, 0xA5, 0xA6, 0xA7,
+    0xA8, 0xA9, 0xAA, 0xB2, 0xB3, 0xB4, 0xB5, 0xB6,
+    0xB7, 0xB8, 0xB9, 0xBA, 0xC2, 0xC3, 0xC4, 0xC5,
+    0xC6, 0xC7, 0xC8, 0xC9, 0xCA, 0xD2, 0xD3, 0xD4,
+    0xD5, 0xD6, 0xD7, 0xD8, 0xD9, 0xDA, 0xE1, 0xE2,
+    0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8, 0xE9, 0xEA,
+    0xF1, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8,
+    0xF9, 0xFA,
+])
+_AC_CHM_BITS = bytes([0, 2, 1, 2, 4, 4, 3, 4, 7, 5, 4, 4, 0, 1, 2, 0x77])
+_AC_CHM_VALS = bytes([
+    0x00, 0x01, 0x02, 0x03, 0x11, 0x04, 0x05, 0x21,
+    0x31, 0x06, 0x12, 0x41, 0x51, 0x07, 0x61, 0x71,
+    0x13, 0x22, 0x32, 0x81, 0x08, 0x14, 0x42, 0x91,
+    0xA1, 0xB1, 0xC1, 0x09, 0x23, 0x33, 0x52, 0xF0,
+    0x15, 0x62, 0x72, 0xD1, 0x0A, 0x16, 0x24, 0x34,
+    0xE1, 0x25, 0xF1, 0x17, 0x18, 0x19, 0x1A, 0x26,
+    0x27, 0x28, 0x29, 0x2A, 0x35, 0x36, 0x37, 0x38,
+    0x39, 0x3A, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48,
+    0x49, 0x4A, 0x53, 0x54, 0x55, 0x56, 0x57, 0x58,
+    0x59, 0x5A, 0x63, 0x64, 0x65, 0x66, 0x67, 0x68,
+    0x69, 0x6A, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78,
+    0x79, 0x7A, 0x82, 0x83, 0x84, 0x85, 0x86, 0x87,
+    0x88, 0x89, 0x8A, 0x92, 0x93, 0x94, 0x95, 0x96,
+    0x97, 0x98, 0x99, 0x9A, 0xA2, 0xA3, 0xA4, 0xA5,
+    0xA6, 0xA7, 0xA8, 0xA9, 0xAA, 0xB2, 0xB3, 0xB4,
+    0xB5, 0xB6, 0xB7, 0xB8, 0xB9, 0xBA, 0xC2, 0xC3,
+    0xC4, 0xC5, 0xC6, 0xC7, 0xC8, 0xC9, 0xCA, 0xD2,
+    0xD3, 0xD4, 0xD5, 0xD6, 0xD7, 0xD8, 0xD9, 0xDA,
+    0xE2, 0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8, 0xE9,
+    0xEA, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8,
+    0xF9, 0xFA,
+])
+
+
+def _dht(table_class: int, table_id: int, bits: bytes,
+         vals: bytes) -> bytes:
+    body = bytes([(table_class << 4) | table_id]) + bits + vals
+    return b"\xff\xc4" + struct.pack(">H", 2 + len(body)) + body
+
+
+# Q < 128 sends NO tables on the wire (RFC 2435 §4.2): both ends
+# derive them from Q by scaling the T.81 Annex K.1 example tables
+# with libjpeg's quality curve (RFC 2435 Appendix A is that exact
+# formula) and storing them in the JPEG zigzag order DQT uses.
+# Validated byte-for-byte against cv2/libjpeg output in
+# tests/test_media.py::test_qtables_from_q_match_libjpeg.
+
+_ZIGZAG = (
+    0, 1, 8, 16, 9, 2, 3, 10, 17, 24, 32, 25, 18, 11, 4, 5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6, 7, 14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63)
+_K1_LUMA = (
+    16, 11, 10, 16, 24, 40, 51, 61,
+    12, 12, 14, 19, 26, 58, 60, 55,
+    14, 13, 16, 24, 40, 57, 69, 56,
+    14, 17, 22, 29, 51, 87, 80, 62,
+    18, 22, 37, 56, 68, 109, 103, 77,
+    24, 35, 55, 64, 81, 104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101,
+    72, 92, 95, 98, 112, 100, 103, 99)
+_K1_CHROMA = (
+    17, 18, 24, 47, 99, 99, 99, 99,
+    18, 21, 26, 66, 99, 99, 99, 99,
+    24, 26, 56, 99, 99, 99, 99, 99,
+    47, 66, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99)
+
+
+def rfc2435_qtables(q: int) -> list[bytes]:
+    """Quantization tables for an RFC 2435 Q value in 1..127
+    (128..255 carry tables in-band instead)."""
+    q = max(1, min(int(q), 99))          # 100..127 reserved: clamp
+    scale = 5000 // q if q < 50 else 200 - 2 * q
+
+    def mk(base: tuple) -> bytes:
+        return bytes(
+            min(255, max(1, (base[_ZIGZAG[k]] * scale + 50) // 100))
+            for k in range(64))
+
+    return [mk(_K1_LUMA), mk(_K1_CHROMA)]
+
+
+def reconstruct_jfif(width: int, height: int, qtables: list[bytes],
+                     scan: bytes, subsampling: int = 1) -> bytes:
+    """Rebuild a decodable baseline JFIF from RFC 2435 pieces — the
+    inverse of ``publish/rtsp.parse_jpeg``. ``subsampling`` is the
+    RFC 2435 type: 0 → 4:2:2, 1 → 4:2:0."""
+    out = bytearray(b"\xff\xd8")                        # SOI
+    for i, tbl in enumerate(qtables[:2]):
+        out += b"\xff\xdb" + struct.pack(">H", 3 + len(tbl))
+        out += bytes([i]) + tbl                          # Pq=0, Tq=i
+    cq = 1 if len(qtables) > 1 else 0
+    lum_sampling = 0x22 if subsampling == 1 else 0x21
+    out += (b"\xff\xc0" + struct.pack(">HBHHB", 17, 8, height, width, 3)
+            + bytes([1, lum_sampling, 0])                # Y
+            + bytes([2, 0x11, cq])                       # Cb
+            + bytes([3, 0x11, cq]))                      # Cr
+    out += _dht(0, 0, _DC_LUM_BITS, _DC_LUM_VALS)
+    out += _dht(1, 0, _AC_LUM_BITS, _AC_LUM_VALS)
+    out += _dht(0, 1, _DC_CHM_BITS, _DC_CHM_VALS)
+    out += _dht(1, 1, _AC_CHM_BITS, _AC_CHM_VALS)
+    out += (b"\xff\xda" + struct.pack(">HB", 12, 3)
+            + bytes([1, 0x00, 2, 0x11, 3, 0x11, 0, 0x3F, 0]))
+    out += scan
+    out += b"\xff\xd9"                                   # EOI
+    return bytes(out)
+
+
+# -------------------------------------------------------------- stream
+
+class DemuxStream:
+    """One live stream's registration — same consumer contract as
+    ``PooledStream`` (bounded queue, ``frames()`` facade, counters),
+    fed by the demux selector + decode workers instead of a reader
+    thread."""
+
+    def __init__(self, stream_id: str, url: str, maxsize: int = 8,
+                 max_pending: int = 4):
+        self.stream_id = stream_id
+        self.url = url
+        self.queue: queue_mod.Queue = queue_mod.Queue(maxsize=maxsize)
+        self.frames_decoded = 0
+        self.frames_dropped = 0
+        self.error: str | None = None
+        self.finished = False
+        self.sock: socket.socket | None = None
+        self._demux: "RtspDemux | None" = None
+        # ---- selector-side state (touched only by the demux thread)
+        self._buf = bytearray()      # raw TCP bytes
+        self._scan = bytearray()     # current frame's entropy scan
+        self._qtables: list[bytes] = []
+        self._qtable_q = -1          # Q the derived tables were built for
+        self._dims = (0, 0)
+        self._last_ts32 = -1         # RTP timestamp unwrap state
+        self._ts_ext = 0
+        self._frame_corrupt = False
+        self._seq = 0
+        # ---- decode-side state (guarded by the demux lock)
+        self._jpegs: deque = deque()          # complete frames waiting
+        self._max_pending = max_pending
+        self._scheduled = False
+        self._eof = False
+        self._removed = False
+        #: selector-side teardown already ran (close may be requested
+        #: from several paths — instance.stop AND the runner's
+        #: finally both close; teardown must be idempotent)
+        self._gone = False
+
+    def frames(self):
+        """Drain until EOS — drop-in for ``VideoSource.frames()``."""
+        while True:
+            ev = self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    def close(self) -> None:
+        """Consumer-side teardown. MUST route through the selector
+        thread: closing a registered fd here would silently drop it
+        from the epoll set (no EOF event ever fires) AND leave a
+        stale entry in the selector's fd map that poisons the next
+        stream whose socket reuses the fd number."""
+        self._removed = True
+        demux = self._demux
+        if demux is not None:
+            demux._request_close(self)
+
+    # pool-side emit (decode workers)
+    def _emit(self, ev: FrameEvent) -> None:
+        self.frames_decoded += 1
+        metrics.inc("evam_frames_decoded",
+                    labels={"stream": self.stream_id})
+        dropped = drop_oldest_put(self.queue, ev)   # live: newest wins
+        if dropped:
+            self.frames_dropped += dropped
+            metrics.inc("evam_frames_dropped", dropped,
+                        labels={"stream": self.stream_id})
+
+    def _finish(self, error: str | None) -> None:
+        if self.finished:
+            return
+        self.error = self.error or error
+        self.finished = True
+        drop_oldest_put(self.queue, None)
+
+
+# --------------------------------------------------------------- demux
+
+class RtspDemux:
+    """N live RTSP streams through 1 selector thread + M decoders.
+
+    ``add_stream`` performs the (blocking, timeout-bounded) RTSP
+    handshake, then hands the socket to the selector; everything
+    after that is non-blocking. Per-stream frame order is preserved:
+    a stream has at most one frame in decode at any moment.
+    """
+
+    def __init__(self, decode_workers: int = 2,
+                 connect_timeout_s: float = 5.0):
+        if decode_workers < 1:
+            raise ValueError("decode_workers must be >= 1")
+        self.connect_timeout_s = connect_timeout_s
+        self._sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._streams: list[DemuxStream] = []
+        #: counters of retired (finished) streams so stats() stays
+        #: cumulative without keeping dead DemuxStream objects alive
+        self._retired_decoded = 0
+        self._retired_dropped = 0
+        #: consumer-side closes waiting for the selector thread
+        self._to_close: list[DemuxStream] = []
+        self._ready: "queue_mod.Queue" = queue_mod.Queue()
+        self._stop = threading.Event()
+        # self-pipe so add_stream/stop can wake the selector
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        self._sel_thread = threading.Thread(
+            target=self._select_loop, name="rtsp-demux", daemon=True)
+        self._sel_thread.start()
+        self._workers = [
+            threading.Thread(target=self._decode_loop,
+                             name=f"rtsp-demux-dec-{i}", daemon=True)
+            for i in range(decode_workers)
+        ]
+        for t in self._workers:
+            t.start()
+
+    # ------------------------------------------------------- lifecycle
+
+    def add_stream(self, url: str, stream_id: str | None = None,
+                   maxsize: int = 8) -> DemuxStream:
+        if self._stop.is_set():
+            raise RuntimeError("demux is stopped")
+        ps = DemuxStream(stream_id or url, url, maxsize=maxsize)
+        ps._demux = self
+        sock, residue = self._handshake(url)
+        sock.setblocking(False)
+        ps.sock = sock
+        ps._buf.extend(residue)   # interleaved data behind the PLAY 200
+        with self._lock:
+            self._streams.append(ps)
+        self._sel.register(sock, selectors.EVENT_READ, ps)
+        self._wake_w.send(b"x")
+        return ps
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        self._sel_thread.join(timeout=10)
+        self._ready.put(None)
+        for t in self._workers:
+            t.join(timeout=10)
+        with self._lock:
+            streams = list(self._streams)
+        for ps in streams:
+            # the selector thread is gone: direct teardown is safe now
+            ps._removed = True
+            sock = ps.sock
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            ps._finish("demux stopped")
+            self._retire(ps)
+
+    def _request_close(self, ps: DemuxStream) -> None:
+        """Hand a consumer-side close to the selector thread (epoll
+        teardown must happen where the registration lives). Falls
+        back to direct teardown when the selector is already gone."""
+        with self._lock:
+            if not self._stop.is_set():
+                self._to_close.append(ps)
+                try:
+                    self._wake_w.send(b"x")
+                except OSError:
+                    pass
+                return
+        # demux stopped: no selector thread to do it
+        sock = ps.sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        ps._finish(ps.error)
+        self._retire(ps)
+
+    def _retire(self, ps: DemuxStream) -> None:
+        """Drop a FINISHED stream from the registry, folding its
+        counters into the cumulative totals (long-lived servers churn
+        streams; dead objects must not accumulate)."""
+        with self._lock:
+            if ps in self._streams:
+                self._streams.remove(ps)
+                self._retired_decoded += ps.frames_decoded
+                self._retired_dropped += ps.frames_dropped
+
+    # ------------------------------------------------------- handshake
+
+    def _handshake(self, url: str) -> tuple[socket.socket, bytes]:
+        """Minimal RTSP client: DESCRIBE → SETUP (TCP interleaved) →
+        PLAY against ``rtsp://host:port/path``."""
+        u = urlparse(url)
+        host, port = u.hostname, u.port or 554
+        sock = socket.create_connection(
+            (host, port), timeout=self.connect_timeout_s)
+        sock.settimeout(self.connect_timeout_s)
+        buf = bytearray()
+
+        def request(method: str, target: str, cseq: int,
+                    extra: str = "") -> dict:
+            msg = f"{method} {target} RTSP/1.0\r\nCSeq: {cseq}\r\n"
+            if extra:
+                msg += extra if extra.endswith("\r\n") else extra + "\r\n"
+            msg += "\r\n"
+            sock.sendall(msg.encode("latin-1"))
+            while b"\r\n\r\n" not in buf:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    raise IOError("rtsp server closed during handshake")
+                buf.extend(chunk)
+            head, _, rest = bytes(buf).partition(b"\r\n\r\n")
+            del buf[:len(head) + 4]
+            lines = head.decode("latin-1").split("\r\n")
+            if " 200 " not in lines[0] + " ":
+                raise IOError(f"rtsp {method} failed: {lines[0]}")
+            headers = {
+                k.strip().lower(): v.strip()
+                for k, v in (l.split(":", 1) for l in lines[1:]
+                             if ":" in l)
+            }
+            # drain any Content-Length body (the SDP)
+            body_len = int(headers.get("content-length", "0"))
+            while len(buf) < body_len:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    raise IOError("rtsp server closed mid-body")
+                buf.extend(chunk)
+            headers["_body"] = bytes(buf[:body_len]).decode("latin-1")
+            del buf[:body_len]
+            return headers
+
+        try:
+            request("DESCRIBE", url, 1, "Accept: application/sdp")
+            h = request(
+                "SETUP", url.rstrip("/") + "/streamid=0", 2,
+                "Transport: RTP/AVP/TCP;unicast;interleaved=0-1")
+            session = h.get("session", "0").split(";")[0]
+            request("PLAY", url, 3, f"Session: {session}")
+        except Exception:
+            sock.close()
+            raise
+        # interleaved data may already trail the PLAY 200 in the same
+        # TCP segments — hand it back so no bytes are lost
+        return sock, bytes(buf)
+
+    # -------------------------------------------------------- selector
+
+    def _select_loop(self) -> None:
+        while not self._stop.is_set():
+            events = self._sel.select(timeout=0.5)
+            # consumer-side closes, executed HERE so unregister
+            # precedes close (epoll registration hygiene)
+            with self._lock:
+                to_close, self._to_close = self._to_close, []
+            for ps in to_close:
+                if ps.sock is not None:
+                    try:
+                        self._socket_gone(ps.sock, ps, None)
+                    except Exception:  # noqa: BLE001
+                        log.exception("demux close of %s failed",
+                                      ps.stream_id)
+            for key, _mask in events:
+                if key.data is None:            # wake pipe
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    self._service_socket(key.fileobj, key.data)
+                except Exception:  # noqa: BLE001
+                    # one stream's parse must never kill ingest for
+                    # every stream — fail that stream, keep looping
+                    log.exception("demux stream %s failed",
+                                  key.data.stream_id)
+                    try:
+                        self._socket_gone(
+                            key.fileobj, key.data, "demux parse error")
+                    except Exception:  # noqa: BLE001
+                        pass
+        # teardown: unregister everything
+        for key in list(self._sel.get_map().values()):
+            try:
+                self._sel.unregister(key.fileobj)
+            except (KeyError, OSError):
+                pass
+        self._sel.close()
+
+    def _service_socket(self, sock, ps: DemuxStream) -> None:
+        try:
+            data = sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as exc:
+            self._socket_gone(sock, ps,
+                              None if ps._removed else str(exc))
+            return
+        if not data:
+            self._socket_gone(
+                sock, ps, None if ps._removed else "rtsp EOF")
+            return
+        ps._buf.extend(data)
+        self._drain_buffer(ps)
+
+    def _socket_gone(self, sock, ps: DemuxStream,
+                     error: str | None) -> None:
+        if ps._gone:
+            return
+        ps._gone = True
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            # ValueError: fd already -1 (closed) — unregister of an
+            # already-torn-down socket must never kill the selector
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if error:
+            metrics.inc("evam_stream_errors",
+                        labels={"stream": ps.stream_id})
+        with self._lock:
+            ps._eof = True
+            ps.error = ps.error or error
+            deliver_now = not ps._scheduled and not ps._jpegs
+        if deliver_now:
+            ps._finish(ps.error)
+            self._retire(ps)
+
+    def _drain_buffer(self, ps: DemuxStream) -> None:
+        buf = ps._buf
+        while True:
+            if len(buf) < 4:
+                return
+            if buf[0] != 0x24:                  # not '$': RTSP msg
+                end = bytes(buf).find(b"\r\n\r\n")
+                if end < 0:
+                    if len(buf) > 65536:
+                        self._socket_gone(
+                            ps.sock, ps, "rtsp framing lost")
+                    return
+                del buf[:end + 4]               # skip server notices
+                continue
+            length = struct.unpack(">H", buf[2:4])[0]
+            if len(buf) < 4 + length:
+                return
+            channel = buf[1]
+            pkt = bytes(buf[4:4 + length])
+            del buf[:4 + length]
+            if channel == 0:                    # RTP (1 = RTCP)
+                self._on_rtp(ps, pkt)
+
+    def _on_rtp(self, ps: DemuxStream, pkt: bytes) -> None:
+        if len(pkt) < 12 or pkt[0] >> 6 != 2:
+            return
+        pt = pkt[1] & 0x7F
+        if pt != 26:
+            # not RFC 2435 JPEG: fail LOUDLY — silently dropping an
+            # H.264 camera's packets would leave the instance RUNNING
+            # forever with zero frames and no visible error
+            self._socket_gone(
+                ps.sock, ps,
+                f"unsupported RTP payload type {pt} — the demux "
+                "speaks RFC 2435 JPEG (PT 26) only; unset "
+                "EVAM_RTSP_DEMUX_WORKERS for this camera (per-stream "
+                "reader handles other codecs via FFmpeg)")
+            return
+        marker = pkt[1] >> 7
+        ts32 = struct.unpack(">I", pkt[4:8])[0]
+        # unwrap the 32-bit RTP timestamp (90 kHz wraps every ~13.25 h
+        # — a 24/7 camera must not publish a regressing pts)
+        if ps._last_ts32 >= 0:
+            delta = (ts32 - ps._last_ts32) & 0xFFFFFFFF
+            if delta >= 0x80000000:          # backward (reorder) move
+                delta -= 1 << 32
+            ps._ts_ext += delta
+        else:
+            ps._ts_ext = ts32
+        ps._last_ts32 = ts32
+        ts = ps._ts_ext
+        payload = pkt[12 + 4 * (pkt[0] & 0x0F):]
+        if len(payload) < 8:
+            return
+        # RFC 2435 main JPEG header
+        offset = (payload[1] << 16) | (payload[2] << 8) | payload[3]
+        jtype, q = payload[4], payload[5]
+        width, height = payload[6] * 8, payload[7] * 8
+        frag = payload[8:]
+        if offset == 0:
+            ps._scan.clear()
+            ps._frame_corrupt = False
+            ps._dims = (width, height)
+            if q >= 128:
+                if len(frag) < 4:
+                    ps._frame_corrupt = True
+                    return
+                qlen = struct.unpack(">H", frag[2:4])[0]
+                qdata = frag[4:4 + qlen]
+                ps._qtables = [qdata[i:i + 64]
+                               for i in range(0, len(qdata), 64)]
+                frag = frag[4 + qlen:]
+            else:
+                # tables derived from Q (static per Q — cache them)
+                if ps._qtable_q != q:
+                    ps._qtables = rfc2435_qtables(q)
+                    ps._qtable_q = q
+        if ps._frame_corrupt:
+            return
+        if offset != len(ps._scan):
+            # TCP keeps order, so a gap means a parse bug or a frame
+            # started mid-stream — drop this frame, resync on offset 0
+            ps._frame_corrupt = True
+            return
+        ps._scan.extend(frag)
+        if marker:
+            jfif = reconstruct_jfif(
+                *ps._dims, ps._qtables, bytes(ps._scan),
+                subsampling=jtype & 0x3F)
+            ps._scan.clear()
+            self._queue_jpeg(ps, jfif, ts)
+
+    def _queue_jpeg(self, ps: DemuxStream, jfif: bytes,
+                    ts: int) -> None:
+        with self._lock:
+            if ps._removed or ps.finished:
+                return
+            ps._jpegs.append((jfif, ts))
+            if len(ps._jpegs) > ps._max_pending:   # live: newest wins
+                ps._jpegs.popleft()
+                ps.frames_dropped += 1
+                metrics.inc("evam_frames_dropped",
+                            labels={"stream": ps.stream_id})
+            if not ps._scheduled:
+                ps._scheduled = True
+                self._ready.put(ps)
+
+    # --------------------------------------------------------- decode
+
+    def _decode_loop(self) -> None:
+        import cv2
+
+        while True:
+            ps = self._ready.get()
+            if ps is None:
+                self._ready.put(None)           # release siblings
+                return
+            with self._lock:
+                if ps._jpegs:
+                    item = ps._jpegs.popleft()
+                    terminal = False
+                else:
+                    item = None
+                    ps._scheduled = False
+                    terminal = ps._eof
+            if item is None:
+                if terminal:                    # decisions in lock,
+                    ps._finish(ps.error)        # actions outside it
+                    self._retire(ps)
+                continue
+            jfif, ts = item
+            if not ps._removed:
+                img = cv2.imdecode(
+                    np.frombuffer(jfif, np.uint8), cv2.IMREAD_COLOR)
+                if img is not None:
+                    ps._seq += 1
+                    ps._emit(FrameEvent(
+                        frame=img,
+                        pts_ns=int(ts * (1_000_000_000 / RTP_CLOCK)),
+                        seq=ps._seq))
+            with self._lock:
+                if ps._jpegs:
+                    self._ready.put(ps)         # stay scheduled
+                    deliver_eos = False
+                else:
+                    ps._scheduled = False
+                    deliver_eos = ps._eof
+            if deliver_eos:
+                ps._finish(ps.error)
+                self._retire(ps)
+
+    # ---------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Live stream count + CUMULATIVE frame counters (retired
+        streams fold their totals in at retirement)."""
+        with self._lock:
+            streams = list(self._streams)
+            decoded = self._retired_decoded
+            dropped = self._retired_dropped
+        return {
+            "streams": len(streams),
+            "threads": 1 + len(self._workers),
+            "decoded": decoded + sum(s.frames_decoded for s in streams),
+            "dropped": dropped + sum(s.frames_dropped for s in streams),
+        }

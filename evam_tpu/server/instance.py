@@ -52,6 +52,7 @@ class StreamInstance:
         on_finish: Callable[["StreamInstance"], None] | None = None,
         source: Any | None = None,
         decode_pool: Any | None = None,
+        rtsp_demux: Any | None = None,
     ):
         self.id = str(uuid.uuid4())
         self.pipeline_name = pipeline_name
@@ -70,6 +71,8 @@ class StreamInstance:
             self.max_retries = 0
         #: shared DecodePool (registry-owned) or None = decode inline
         self._decode_pool = decode_pool
+        #: shared RtspDemux (registry-owned) or None = blocking reader
+        self._rtsp_demux = rtsp_demux
 
         self.state = InstanceState.QUEUED
         self.error: str | None = None
@@ -162,9 +165,20 @@ class StreamInstance:
                     pass
 
     def _run_once(self) -> None:
+        src_cfg0 = self.request.get("source", {})
+        # Live RTSP through the async demux (VERDICT r4 item 3): one
+        # selector thread + shared decode workers for every rtsp://
+        # source — no per-stream blocking reader. The demux owns the
+        # socket end-to-end, so skip create_source entirely.
+        if (self._rtsp_demux is not None
+                and self._injected_source is None
+                and src_cfg0.get("type", "uri") == "uri"
+                and str(src_cfg0.get("uri", "")).startswith("rtsp://")):
+            self._run_once_demux(src_cfg0["uri"])
+            return
         source = self._injected_source or create_source(
-            self.request.get("source", {}),
-            realtime=bool(self.request.get("source", {}).get("realtime", False)),
+            src_cfg0,
+            realtime=bool(src_cfg0.get("realtime", False)),
         )
         with self._src_lock:
             if self._stop.is_set():
@@ -174,9 +188,9 @@ class StreamInstance:
         self._runner = StreamRunner(
             stream_id=self.id,
             stages=self.stages,
-            source_uri=self.request.get("source", {}).get("uri", ""),
+            source_uri=src_cfg0.get("uri", ""),
         )
-        src_cfg = self.request.get("source", {})
+        src_cfg = src_cfg0
         pooled = None
         # Shared decode pool — ONLY for free-running uri sources
         # (file/VOD/synthetic replay). Sources whose frames() blocks
@@ -189,7 +203,10 @@ class StreamInstance:
         if (self._decode_pool is not None
                 and self._injected_source is None
                 and src_cfg.get("type", "uri") == "uri"
-                and not src_cfg.get("realtime", False)):
+                and not src_cfg.get("realtime", False)
+                # live RTSP blocks between frames even without the
+                # realtime flag — never let it pin a pool worker
+                and not str(src_cfg.get("uri", "")).startswith("rtsp://")):
             # restart supervision stays HERE (max_restarts=0 in the
             # pool → its error surfaces below and the instance retry
             # path recreates everything); lossless backpressure
@@ -213,6 +230,30 @@ class StreamInstance:
             with self._src_lock:
                 source.close()
                 if self._source is source:
+                    self._source = None
+
+    def _run_once_demux(self, uri: str) -> None:
+        """One attempt over the shared async RTSP demux: the demux
+        owns socket + depacketize + decode; this thread only consumes
+        the bounded frame queue. Restart supervision stays with the
+        instance retry loop (a handshake/socket error surfaces as
+        IOError here and the outer loop reconnects)."""
+        stream = self._rtsp_demux.add_stream(uri, stream_id=self.id[:8])
+        with self._src_lock:
+            if self._stop.is_set():
+                stream.close()
+                return
+            self._source = stream
+        self._runner = StreamRunner(
+            stream_id=self.id, stages=self.stages, source_uri=uri)
+        try:
+            self._runner.run(stream.frames())
+            if stream.error:
+                raise IOError(stream.error)
+        finally:
+            with self._src_lock:
+                stream.close()
+                if self._source is stream:
                     self._source = None
 
     # --------------------------------------------------------- status

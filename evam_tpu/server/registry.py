@@ -63,6 +63,16 @@ class PipelineRegistry:
 
             self.decode_pool = DecodePool(
                 workers=settings.decode_pool_workers)
+        #: async live-RTSP demux (opt-in, EVAM_RTSP_DEMUX_WORKERS>0):
+        #: one selector thread + N decode workers for ALL rtsp://
+        #: sources — live streams stop pinning a reader thread each
+        #: (media/demux.py; VERDICT r4 item 3)
+        self.rtsp_demux = None
+        if settings.rtsp_demux_workers > 0:
+            from evam_tpu.media.demux import RtspDemux
+
+            self.rtsp_demux = RtspDemux(
+                decode_workers=settings.rtsp_demux_workers)
         self.instances: dict[str, StreamInstance] = {}
         self._lock = threading.Lock()
         self._draining = False
@@ -195,6 +205,7 @@ class PipelineRegistry:
             on_finish=lambda _inst: self._on_instance_finish(cleanup_fns),
             source=source,
             decode_pool=self.decode_pool,
+            rtsp_demux=self.rtsp_demux,
         )
         meta_fn = publish_fn or (lambda ctx: destination.publish(ctx.metadata))
         frame_cfg = (request.get("destination") or {}).get("frame") or {}
@@ -305,6 +316,8 @@ class PipelineRegistry:
             inst.wait(timeout=5)
         if self.decode_pool is not None:
             self.decode_pool.stop()
+        if self.rtsp_demux is not None:
+            self.rtsp_demux.stop()
         for inst in active:
             if inst._thread is not None and inst._thread.is_alive():
                 # wait() timed out: this worker may still assign ids

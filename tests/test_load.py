@@ -294,3 +294,183 @@ class TestDecodePoolLoad:
             assert total == self.N_STREAMS * self.FRAMES
         finally:
             reg.stop_all()
+
+
+class TestLiveRtspSoak:
+    """North-star config 5's INGEST shape, live-paced (VERDICT r4
+    item 5): 64 camera-paced RTSP streams → async demux (1 selector
+    + 2 shared decoders, media/demux.py) → shared fused engine →
+    track → publish, with per-frame fault injection on. Asserts the
+    thread bound (no per-stream readers), per-stream progress, loss
+    accounting, and clean mid-run churn."""
+
+    N = 64
+    FPS = 2.0   # 128 f/s aggregate — inside this 1-vCPU box's full-
+    # pipeline capacity, so drops measure the framework, not the host
+    # (demux alone sustains 64×6 f/s with zero drops — see
+    # test_demux_alone_is_lossless; the full-path ceiling is the
+    # engine/runner on this box, recorded in INGEST.md)
+
+    def test_demux_alone_is_lossless(self, eight_devices):
+        """64 live streams at 6 f/s each through the demux with
+        instant consumers: zero drops — the demux layer itself never
+        loses frames; live drop-oldest only engages when the
+        downstream consumer lags."""
+        import numpy as np
+
+        from evam_tpu.media.demux import RtspDemux
+        from evam_tpu.publish.rtsp import RtspServer
+
+        srv = RtspServer(port=0, host="127.0.0.1")
+        srv.start()
+        stop_feed = threading.Event()
+
+        def feeder(relay):
+            k = 0
+            f = np.zeros((96, 96, 3), np.uint8)
+            while not stop_feed.is_set():
+                f[:, :, 1] = (k * 5) % 256
+                relay.push_bgr(f)
+                k += 1
+                time.sleep(1 / 6.0)
+
+        for i in range(64):
+            threading.Thread(
+                target=feeder, args=(srv.mount(f"cam{i}",),),
+                daemon=True).start()
+        dmx = RtspDemux(decode_workers=2)
+        try:
+            streams = [
+                dmx.add_stream(f"rtsp://127.0.0.1:{srv.port}/cam{i}",
+                               stream_id=f"s{i}")
+                for i in range(64)
+            ]
+            for s in streams:
+                threading.Thread(
+                    target=lambda s=s: [None for _ in s.frames()],
+                    daemon=True).start()
+            time.sleep(8)
+            st = dmx.stats()
+            assert st["decoded"] > 64 * 6 * 4      # real live volume
+            assert st["dropped"] == 0, st
+            assert st["threads"] == 3
+        finally:
+            stop_feed.set()
+            dmx.stop()
+            srv.stop()
+
+    def test_64_live_streams_soak(self, eight_devices, monkeypatch):
+        import numpy as np
+
+        from evam_tpu.publish.rtsp import RtspServer
+
+        monkeypatch.setenv("EVAM_FAULT_INJECT", "error=0.05")
+        srv = RtspServer(port=0, host="127.0.0.1")
+        srv.start()
+        stop_feed = threading.Event()
+
+        def feeder(relay, i):
+            k = 0
+            f = np.zeros((96, 96, 3), np.uint8)
+            f[:, :, 2] = (3 * i) % 256
+            while not stop_feed.is_set():
+                f[:, :, 1] = (k * 5) % 256
+                relay.push_bgr(f)
+                k += 1
+                time.sleep(1 / self.FPS)
+
+        feeders = [
+            threading.Thread(
+                target=feeder, args=(srv.mount(f"cam{i}"), i),
+                daemon=True)
+            for i in range(self.N)
+        ]
+        for t in feeders:
+            t.start()
+
+        reg = make_registry(settings_kw={"rtsp_demux_workers": 2})
+        try:
+            # preload + warm engines BEFORE live traffic: lazy compile
+            # under 64 already-running live streams would blow the
+            # bounded queues (drop-oldest) for the whole compile —
+            # the same preload-first doctrine the TPU serve bench uses
+            reg.preload("object_tracking")
+            for name, e in reg.hub._engines.items():
+                e.warmed.wait(timeout=120)
+            instances = [
+                reg.start_instance(
+                    "object_tracking", "person_vehicle_bike",
+                    {
+                        "source": {
+                            "uri": f"rtsp://127.0.0.1:{srv.port}/cam{i}",
+                            "type": "uri",
+                        },
+                        "destination": {"metadata": {"type": "null"}},
+                        "parameters": {"detection-threshold": 0.0},
+                    },
+                )
+                for i in range(self.N)
+            ]
+            # ---- thread bound: the demux serves all 64 live streams
+            # with 3 threads; NO per-stream reader threads exist
+            time.sleep(4)
+            demux_threads = [
+                t for t in threading.enumerate()
+                if t.name.startswith("rtsp-demux")
+            ]
+            assert len(demux_threads) == 3, [t.name for t in demux_threads]
+            readers = [
+                t for t in threading.enumerate()
+                if t.name.startswith("decode-")
+                and not t.name.startswith("decode-pool")
+            ]
+            assert not readers, [t.name for t in readers]
+
+            # ---- churn: DELETE 8 streams mid-run; they must settle
+            # without disturbing the rest
+            churned = instances[: 8]
+            for inst in churned:
+                reg.stop_instance(inst.id)
+            for inst in churned:
+                inst.wait(timeout=30)
+                assert inst.state.value in ("ABORTED", "COMPLETED"), \
+                    inst.state
+
+            survivors = instances[8:]
+            # steady-state window: snapshot AFTER the 64-handshake
+            # startup storm and the churn transient — the drop claim
+            # is about sustained live serving, not connection bursts
+            demux = reg.rtsp_demux
+            base = demux.stats()
+            progress_t0 = {i.id: i._runner.frames_out
+                           for i in survivors if i._runner}
+            time.sleep(6)
+            # ---- every surviving stream keeps making progress at
+            # the live pace (paced by the camera, not free-running)
+            stalled = [
+                inst.id[:8] for inst in survivors
+                if inst._runner is None
+                or inst._runner.frames_out
+                <= progress_t0.get(inst.id, 0)
+            ]
+            assert not stalled, f"stalled live streams: {stalled}"
+            assert all(i.state.value == "RUNNING" for i in survivors)
+
+            # ---- loss accounting over the steady-state window:
+            # frames the demux delivered either came out of the
+            # runner or were consumed by the injected faults; live
+            # drop-oldest stays a small fraction on this 1-vCPU box
+            # (numbers recorded in INGEST.md)
+            stats = demux.stats()
+            win_decoded = stats["decoded"] - base["decoded"]
+            win_dropped = stats["dropped"] - base["dropped"]
+            assert win_decoded > 0
+            drop_frac = win_dropped / max(1, win_decoded)
+            assert drop_frac < 0.10, (base, stats)
+            total_out = sum(
+                i._runner.frames_out for i in survivors if i._runner)
+            assert total_out > self.N * 0.5 * self.FPS  # real throughput
+        finally:
+            stop_feed.set()
+            reg.stop_all()
+            srv.stop()

@@ -453,3 +453,337 @@ class TestH264Generator:
         events = list(FileSource(path).frames())
         assert len(events) == 3
         assert events[0].frame.shape == (64, 64, 3)
+
+
+class TestRtspDemux:
+    """Async live-RTSP demux (media/demux.py, VERDICT r4 item 3):
+    N paced live streams through 1 selector thread + M decode
+    workers, per-stream order preserved, no per-stream reader."""
+
+    @staticmethod
+    def _start_server(n_streams, fps=15.0):
+        import threading as th
+
+        from evam_tpu.publish.rtsp import RtspServer
+
+        srv = RtspServer(port=0, host="127.0.0.1")
+        srv.start()
+        stop = th.Event()
+
+        def feeder(relay, i):
+            k = 0
+            while not stop.is_set():
+                f = np.zeros((96, 128, 3), np.uint8)
+                f[:, :, 2] = 20 * i          # per-stream identity
+                f[:, :, 1] = (k * 8) % 256   # per-frame ramp (order)
+                relay.push_bgr(f)
+                k += 1
+                time.sleep(1 / fps)
+
+        threads = [
+            th.Thread(target=feeder, args=(srv.mount(f"cam{i}"), i),
+                      daemon=True)
+            for i in range(n_streams)
+        ]
+        for t in threads:
+            t.start()
+        return srv, stop
+
+    def test_paced_streams_share_bounded_threads(self):
+        import threading as th
+
+        from evam_tpu.media.demux import RtspDemux
+
+        n, fps, want = 4, 15.0, 15
+        srv, stop = self._start_server(n, fps)
+        dmx = RtspDemux(decode_workers=2)
+        try:
+            streams = [
+                dmx.add_stream(f"rtsp://127.0.0.1:{srv.port}/cam{i}",
+                               stream_id=f"s{i}")
+                for i in range(n)
+            ]
+            got = {i: [] for i in range(n)}
+
+            def consume(i, s):
+                for ev in s.frames():
+                    got[i].append(ev)
+                    if len(got[i]) >= want:
+                        s.close()
+                        return
+
+            t0 = time.monotonic()
+            cs = [th.Thread(target=consume, args=(i, s), daemon=True)
+                  for i, s in enumerate(streams)]
+            for t in cs:
+                t.start()
+            for t in cs:
+                t.join(timeout=30)
+            elapsed = time.monotonic() - t0
+
+            # total demux threads bounded: 1 selector + 2 decoders,
+            # NOT one reader per stream
+            assert dmx.stats()["threads"] == 3
+            # pacing preserved: 15 frames at 15 fps cannot arrive
+            # faster than ~0.9 s (frames are produced live)
+            assert elapsed > 0.8, elapsed
+            for i in range(n):
+                evs = got[i]
+                assert len(evs) >= want, (i, len(evs))
+                # stream identity survives demux + decode
+                assert all(
+                    abs(int(e.frame[40, 60, 2]) - 20 * i) <= 6
+                    for e in evs), i
+                # order preserved per stream
+                pts = [e.pts_ns for e in evs]
+                assert pts == sorted(pts)
+                seqs = [e.seq for e in evs]
+                assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+        finally:
+            stop.set()
+            dmx.stop()
+            srv.stop()
+
+    def test_server_gone_surfaces_error_and_eos(self):
+        from evam_tpu.media.demux import RtspDemux
+
+        srv, stop = self._start_server(1)
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="s0")
+            it = s.frames()
+            next(it)                     # stream is live
+            stop.set()
+            srv.stop()                   # server dies mid-stream
+            for _ in it:                 # must terminate via EOS
+                pass
+            assert s.finished
+            assert s.error               # and the error is visible
+        finally:
+            dmx.stop()
+
+    def test_connect_refused_raises(self):
+        import pytest
+
+        from evam_tpu.media.demux import RtspDemux
+
+        dmx = RtspDemux(decode_workers=1, connect_timeout_s=1.0)
+        try:
+            with pytest.raises(OSError):
+                dmx.add_stream("rtsp://127.0.0.1:1/nope")
+        finally:
+            dmx.stop()
+
+    def test_jfif_reconstruction_is_parse_inverse(self):
+        """reconstruct_jfif must rebuild a decodable JFIF from the
+        exact pieces publish/rtsp.parse_jpeg extracts."""
+        import cv2
+
+        from evam_tpu.media.demux import reconstruct_jfif
+        from evam_tpu.publish.rtsp import parse_jpeg
+
+        f = np.zeros((96, 128, 3), np.uint8)
+        f[:, :] = (40, 90, 160)
+        f[20:60, 30:70] = (200, 60, 30)
+        ok, buf = cv2.imencode(".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, 80])
+        assert ok
+        w, h, qtables, scan = parse_jpeg(buf.tobytes())
+        jfif = reconstruct_jfif(w, h, qtables, scan)
+        img = cv2.imdecode(np.frombuffer(jfif, np.uint8),
+                           cv2.IMREAD_COLOR)
+        assert img is not None and img.shape == (96, 128, 3)
+        ref = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        assert float(np.abs(img.astype(int) - ref.astype(int)).mean()) < 0.5
+
+    def test_qtables_from_q_match_libjpeg(self):
+        """RFC 2435 Q<128: no tables on the wire, both ends derive
+        them from Q. The RFC's Appendix-A scaling is libjpeg's
+        quality curve over the same T.81 K.1 tables, so our derived
+        tables must match what cv2/libjpeg embeds in a JPEG encoded
+        at that quality — byte-for-byte, zigzag order and all."""
+        import cv2
+
+        from evam_tpu.media.demux import rfc2435_qtables
+        from evam_tpu.publish.rtsp import parse_jpeg
+
+        f = np.zeros((64, 64, 3), np.uint8)
+        f[16:48, 16:48] = (200, 60, 30)
+        for q in (25, 50, 75, 90):
+            ok, buf = cv2.imencode(
+                ".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, q])
+            assert ok
+            _, _, file_tables, _ = parse_jpeg(buf.tobytes())
+            derived = rfc2435_qtables(q)
+            assert derived[0] == file_tables[0], f"luma Q={q}"
+            assert derived[1] == file_tables[1], f"chroma Q={q}"
+
+    def test_q50_wire_without_inband_tables_decodes(self):
+        """End-to-end Q<128 path: packetize a real JPEG's scan with
+        q=50 and NO in-band tables; the demux must rebuild the exact
+        tables from Q and decode to the original pixels."""
+        import struct as st
+
+        import cv2
+
+        from evam_tpu.media.demux import RtspDemux
+        from evam_tpu.publish.rtsp import parse_jpeg
+
+        f = np.zeros((64, 64, 3), np.uint8)
+        f[:, :] = (40, 90, 160)
+        f[16:48, 16:48] = (200, 60, 30)
+        ok, buf = cv2.imencode(".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, 50])
+        w, h, _tables, scan = parse_jpeg(buf.tobytes())
+
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            # drive _on_rtp directly with hand-built RFC 2435 packets
+            from evam_tpu.media.demux import DemuxStream
+
+            ps = DemuxStream("q50", "rtsp://test/q50")
+            ps._demux = dmx
+            with dmx._lock:
+                dmx._streams.append(ps)
+            rtp_hdr = st.pack("!BBHII", 0x80, 0x80 | 26, 1, 9000, 1)
+            jpeg_hdr = st.pack("!BBBBBB", 0, 0, 0, 0, 1, 50) \
+                + bytes([w // 8, h // 8])
+            dmx._on_rtp(ps, rtp_hdr + jpeg_hdr + scan)
+            ev = ps.queue.get(timeout=10)
+            assert ev is not None
+            ref = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+            err = float(np.abs(ev.frame.astype(int)
+                               - ref.astype(int)).mean())
+            assert err < 0.5, err
+        finally:
+            dmx.stop()
+
+    def test_consumer_close_unblocks_and_allows_fd_reuse(self):
+        """Consumer-side close() must deliver EOS through the
+        selector thread (a directly-closed fd never fires an epoll
+        event) and must unregister the fd so a new stream reusing
+        the fd number can register cleanly."""
+        import threading as th
+
+        from evam_tpu.media.demux import RtspDemux
+
+        srv, stop = self._start_server(1)
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s1 = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="a")
+            it = s1.frames()
+            next(it)                         # live
+            done = th.Event()
+
+            def drain():
+                for _ in it:
+                    pass
+                done.set()
+
+            th.Thread(target=drain, daemon=True).start()
+            s1.close()                       # consumer-side close
+            assert done.wait(timeout=10), \
+                "close() did not deliver EOS (selector never woke)"
+            # the closed stream retired from the registry
+            deadline = time.time() + 5
+            while time.time() < deadline and dmx.stats()["streams"]:
+                time.sleep(0.05)
+            assert dmx.stats()["streams"] == 0
+            # fd reuse: a new stream (likely same fd number) registers
+            s2 = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="b")
+            ev = next(s2.frames())
+            assert ev.frame is not None
+            s2.close()
+        finally:
+            stop.set()
+            dmx.stop()
+            srv.stop()
+
+    def test_double_close_keeps_other_streams_alive(self):
+        """Regression: close() can be requested from several paths
+        (instance.stop AND the runner's finally). A second teardown
+        of an already-closed fd must not kill the selector thread —
+        every other live stream would silently stop."""
+        from evam_tpu.media.demux import RtspDemux
+
+        srv, stop = self._start_server(2)
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s0 = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="a")
+            s1 = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam1", stream_id="b")
+            next(s0.frames())
+            next(s1.frames())
+            # queue the close twice before the selector drains — the
+            # second teardown sees an fd of -1
+            s0.close()
+            s0.close()
+            time.sleep(1.0)
+            # the selector survived: stream b still delivers frames
+            before = s1.frames_decoded
+            deadline = time.time() + 10
+            while time.time() < deadline and s1.frames_decoded == before:
+                time.sleep(0.1)
+            assert s1.frames_decoded > before, \
+                "selector thread died after double close"
+        finally:
+            stop.set()
+            dmx.stop()
+            srv.stop()
+
+    def test_rtp_timestamp_unwrap(self):
+        """The 32-bit 90 kHz RTP timestamp wraps every ~13.25 h — a
+        24/7 camera's pts must keep increasing across the wrap."""
+        import struct as st
+
+        import cv2
+
+        from evam_tpu.media.demux import DemuxStream, RtspDemux
+        from evam_tpu.publish.rtsp import parse_jpeg
+
+        f = np.full((64, 64, 3), 90, np.uint8)
+        ok, buf = cv2.imencode(".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, 50])
+        w, h, _t, scan = parse_jpeg(buf.tobytes())
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            ps = DemuxStream("wrap", "rtsp://test/wrap")
+            ps._demux = dmx
+            with dmx._lock:
+                dmx._streams.append(ps)
+            jpeg_hdr = st.pack("!BBBBBB", 0, 0, 0, 0, 1, 50) \
+                + bytes([w // 8, h // 8])
+            pts = []
+            for ts32 in (0xFFFFFE00, 0x00000100):  # across the wrap
+                rtp = st.pack("!BBHII", 0x80, 0x80 | 26, 1, ts32, 1)
+                dmx._on_rtp(ps, rtp + jpeg_hdr + scan)
+                pts.append(ps.queue.get(timeout=10).pts_ns)
+            assert pts[1] > pts[0], pts  # monotonic across wrap
+        finally:
+            dmx.stop()
+
+    def test_wrong_payload_type_fails_loudly(self):
+        """A non-MJPEG camera (e.g. H.264, PT 96) must surface an
+        error instead of sitting RUNNING with zero frames."""
+        import struct as st
+
+        from evam_tpu.media.demux import RtspDemux
+
+        srv, stop = self._start_server(1)
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="s0")
+            next(s.frames())                 # stream is live, PT 26 ok
+            # inject a PT-96 packet as if the camera switched codecs
+            rtp = st.pack("!BBHII", 0x80, 0x80 | 96, 7, 1234, 1)
+            dmx._on_rtp(s, rtp + b"\x00" * 16)
+            for _ in s.frames():             # must terminate via EOS
+                pass
+            assert s.finished
+            assert s.error and "payload type 96" in s.error
+        finally:
+            stop.set()
+            dmx.stop()
+            srv.stop()
