@@ -17,6 +17,8 @@ from pathlib import Path
 
 ENTRIES = [
     ("default", "headline: raw engine loop, default config"),
+    ("serve_safe", "serving path, 64 streams, b256, "
+                   "EVAM_SERIALIZE_COMPILE wedge-proof mode"),
     ("serve", "serving path, 64 streams, b256, seed ingest"),
     ("serve_b128", "serving path, 64 streams, b128"),
     ("serve_file_32", "serving path, 32 streams, file publish"),
@@ -31,6 +33,9 @@ ENTRIES = [
     ("budget", "on-device step time + 40ms budget table"),
     ("accuracy", "accuracy harness forward on the real chip"),
     ("host", "host-ingest point (tunnel-bound here)"),
+    ("wedge_repro", "deliberate compile-racing-dispatch repro "
+                    "(LAST: may wedge — that outcome is the datum)"),
+    ("wedge_repro_locked", "same repro under the global devlock"),
 ]
 
 
