@@ -20,11 +20,15 @@ per-stream order by servicing at most one frame per stream at a
 time. Total threads for N streams = 1 selector + ``decode_workers``,
 regardless of N.
 
-Scope: RTP/MJPEG over TCP-interleaved transport — the dialect
-``publish/rtsp.py`` speaks, so an evam-tpu deployment can fan its own
-re-streams back in, and any RFC-2435 camera works. H.264 RTP
-depacketization (RFC 6184) would slot into ``_on_rtp`` the same way;
-recorded as future work in INGEST.md.
+Scope: TCP-interleaved transport, two payload formats negotiated from
+the DESCRIBE SDP — RTP/MJPEG (RFC 2435: in-band Q≥128 tables and the
+Q<128 derive-from-Q path) as ``publish/rtsp.py`` speaks it, so an
+evam-tpu deployment can fan its own re-streams back in and any
+RFC-2435 camera works; and RTP/H.264 (RFC 6184 packetization-mode 1:
+single NAL / STAP-A / FU-A reassembly into Annex-B access units) for
+INTRA-ONLY streams — the in-image decoder is cv2's bundled FFmpeg
+behind a per-AU file shim (see ``_decode_h264_au``), so inter-coded
+cameras stay on the per-stream reader path.
 
 Consumer contract matches ``PooledStream``: ``frames()`` iterator on
 a bounded queue with live drop-oldest semantics, decoded/dropped
@@ -189,6 +193,73 @@ def reconstruct_jfif(width: int, height: int, qtables: list[bytes],
     return bytes(out)
 
 
+def _decode_h264_au(au: bytes):
+    """Decode ONE self-contained Annex-B access unit (SPS+PPS+IDR).
+
+    The image has no ffmpeg binary and no libav Python binding — the
+    only H.264 decoder reachable in-process is cv2.VideoCapture's
+    bundled FFmpeg, which reads files/URLs. Each AU is written to a
+    tmpfs-backed file and opened as a one-frame elementary stream.
+    This is honest about its scope: it only works when every AU is
+    self-contained, i.e. INTRA-ONLY streams (all-I camera mode, or
+    media/h264.py output); inter-coded streams need a stateful
+    decoder feed and stay on the per-stream reader path. ~1 open per
+    frame costs ~ms on tmpfs — fine for the all-I use case, recorded
+    in INGEST.md."""
+    import os
+    import tempfile
+
+    import cv2
+
+    d = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    fd, path = tempfile.mkstemp(suffix=".h264", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(au)
+        cap = cv2.VideoCapture(path)
+        ok, img = cap.read()
+        cap.release()
+        return img if ok else None
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def _parse_sdp_media(sdp: str) -> dict:
+    """Pull the video payload type + codec out of a DESCRIBE SDP.
+    Static PT 26 = RFC 2435 JPEG; dynamic PTs resolve via rtpmap
+    (H264/90000 → the RFC 6184 path)."""
+    pt = 26
+    codec = "jpeg"
+    for line in sdp.splitlines():
+        line = line.strip()
+        if line.startswith("m=video"):
+            parts = line.split()
+            if len(parts) >= 4:
+                try:
+                    pt = int(parts[3])
+                except ValueError:
+                    pass
+            codec = "jpeg" if pt == 26 else "unknown"
+        elif line.lower().startswith(f"a=rtpmap:{pt} "):
+            enc = line.split(" ", 1)[1].split("/")[0].strip().upper()
+            if enc == "H264":
+                codec = "h264"
+            elif enc in ("JPEG", "MJPEG"):
+                codec = "jpeg"
+        elif (line.lower().startswith(f"a=fmtp:{pt} ")
+              and "packetization-mode" in line):
+            # only mode 0/1 (non-interleaved) is reassembled here;
+            # mode 2 (STAP-B/MTAP/FU-B) must be rejected at
+            # add_stream, not discovered as a silent stall
+            mode = line.split("packetization-mode=", 1)[1]
+            if mode.split(";")[0].strip() not in ("0", "1"):
+                codec = "unknown"
+    return {"pt": pt, "codec": codec}
+
+
 # -------------------------------------------------------------- stream
 
 class DemuxStream:
@@ -216,6 +287,13 @@ class DemuxStream:
         self._dims = (0, 0)
         self._last_ts32 = -1         # RTP timestamp unwrap state
         self._ts_ext = 0
+        self._codec = "jpeg"         # from the DESCRIBE SDP
+        self._pt = 26
+        # ---- RFC 6184 reassembly state (h264 streams)
+        self._nals: list[bytes] = []   # current access unit's NALs
+        self._fu: bytearray | None = None   # in-flight FU-A NAL
+        self._sps: bytes | None = None      # cached parameter sets
+        self._pps: bytes | None = None
         self._frame_corrupt = False
         self._seq = 0
         # ---- decode-side state (guarded by the demux lock)
@@ -317,7 +395,15 @@ class RtspDemux:
             raise RuntimeError("demux is stopped")
         ps = DemuxStream(stream_id or url, url, maxsize=maxsize)
         ps._demux = self
-        sock, residue = self._handshake(url)
+        sock, residue, media = self._handshake(url)
+        if media["codec"] == "unknown":
+            sock.close()
+            raise IOError(
+                f"unsupported RTSP media (payload type {media['pt']}) "
+                "— the demux speaks RFC 2435 JPEG and RFC 6184 H.264; "
+                "unset EVAM_RTSP_DEMUX_WORKERS for this camera")
+        ps._codec = media["codec"]
+        ps._pt = media["pt"]
         sock.setblocking(False)
         ps.sock = sock
         ps._buf.extend(residue)   # interleaved data behind the PLAY 200
@@ -385,9 +471,11 @@ class RtspDemux:
 
     # ------------------------------------------------------- handshake
 
-    def _handshake(self, url: str) -> tuple[socket.socket, bytes]:
+    def _handshake(self, url: str) -> tuple[socket.socket, bytes, dict]:
         """Minimal RTSP client: DESCRIBE → SETUP (TCP interleaved) →
-        PLAY against ``rtsp://host:port/path``."""
+        PLAY against ``rtsp://host:port/path``. Returns the socket,
+        any interleaved bytes that trailed the PLAY 200, and media
+        info from the SDP ({"codec": "jpeg"|"h264", "pt": int})."""
         u = urlparse(url)
         host, port = u.hostname, u.port or 554
         sock = socket.create_connection(
@@ -429,7 +517,8 @@ class RtspDemux:
             return headers
 
         try:
-            request("DESCRIBE", url, 1, "Accept: application/sdp")
+            d = request("DESCRIBE", url, 1, "Accept: application/sdp")
+            media = _parse_sdp_media(d.get("_body", ""))
             h = request(
                 "SETUP", url.rstrip("/") + "/streamid=0", 2,
                 "Transport: RTP/AVP/TCP;unicast;interleaved=0-1")
@@ -440,7 +529,7 @@ class RtspDemux:
             raise
         # interleaved data may already trail the PLAY 200 in the same
         # TCP segments — hand it back so no bytes are lost
-        return sock, bytes(buf)
+        return sock, bytes(buf), media
 
     # -------------------------------------------------------- selector
 
@@ -554,14 +643,15 @@ class RtspDemux:
         if len(pkt) < 12 or pkt[0] >> 6 != 2:
             return
         pt = pkt[1] & 0x7F
-        if pt != 26:
-            # not RFC 2435 JPEG: fail LOUDLY — silently dropping an
-            # H.264 camera's packets would leave the instance RUNNING
-            # forever with zero frames and no visible error
+        if pt != ps._pt:
+            # not the negotiated payload: fail LOUDLY — silently
+            # dropping a codec-switched camera's packets would leave
+            # the instance RUNNING forever with zero frames and no
+            # visible error
             self._socket_gone(
                 ps.sock, ps,
-                f"unsupported RTP payload type {pt} — the demux "
-                "speaks RFC 2435 JPEG (PT 26) only; unset "
+                f"unexpected RTP payload type {pt} (SDP negotiated "
+                f"{ps._pt}/{ps._codec}) — unset "
                 "EVAM_RTSP_DEMUX_WORKERS for this camera (per-stream "
                 "reader handles other codecs via FFmpeg)")
             return
@@ -579,6 +669,9 @@ class RtspDemux:
         ps._last_ts32 = ts32
         ts = ps._ts_ext
         payload = pkt[12 + 4 * (pkt[0] & 0x0F):]
+        if ps._codec == "h264":
+            self._on_rtp_h264(ps, payload, bool(marker), ts)
+            return
         if len(payload) < 8:
             return
         # RFC 2435 main JPEG header
@@ -619,12 +712,81 @@ class RtspDemux:
             ps._scan.clear()
             self._queue_jpeg(ps, jfif, ts)
 
+    def _on_rtp_h264(self, ps: DemuxStream, payload: bytes,
+                     marker: bool, ts: int) -> None:
+        """RFC 6184 depacketization: single NAL units, STAP-A
+        aggregates, FU-A fragments → Annex-B access units on the
+        marker bit. SPS/PPS are cached and re-prepended so each AU
+        handed to decode is self-contained (the file-shim decoder
+        needs it; intra-only streams guarantee it suffices)."""
+        if not payload:
+            return
+        nal_type = payload[0] & 0x1F
+        if nal_type == 28 and len(payload) >= 2:        # FU-A
+            fu = payload[1]
+            start, end = fu & 0x80, fu & 0x40
+            if start:
+                ps._fu = bytearray(
+                    bytes([(payload[0] & 0xE0) | (fu & 0x1F)]))
+            if ps._fu is not None:
+                ps._fu.extend(payload[2:])
+                if end:
+                    self._h264_nal(ps, bytes(ps._fu))
+                    ps._fu = None
+        elif nal_type == 24:                            # STAP-A
+            i = 1
+            while i + 2 <= len(payload):
+                size = struct.unpack(">H", payload[i:i + 2])[0]
+                self._h264_nal(ps, payload[i + 2:i + 2 + size])
+                i += 2 + size
+        elif 1 <= nal_type <= 23:                       # single NAL
+            self._h264_nal(ps, payload)
+        else:
+            # STAP-B/MTAP/FU-B (interleaved mode) or reserved types:
+            # fail LOUDLY — silently skipping them would leave the
+            # stream RUNNING with zero frames forever (the same
+            # failure the payload-type check above rejects)
+            self._socket_gone(
+                ps.sock, ps,
+                f"unsupported H.264 RTP NAL type {nal_type} "
+                "(packetization-mode 1 only: single NAL / STAP-A / "
+                "FU-A) — unset EVAM_RTSP_DEMUX_WORKERS for this "
+                "camera")
+            return
+        if marker and ps._nals:
+            nals = ps._nals
+            ps._nals = []
+            # self-contained AU: ensure BOTH parameter sets lead it
+            # (cameras commonly repeat SPS per IDR but send PPS once)
+            if not any(n[0] & 0x1F == 8 for n in nals) \
+                    and ps._pps is not None:
+                nals.insert(0, ps._pps)
+            if not any(n[0] & 0x1F == 7 for n in nals) \
+                    and ps._sps is not None:
+                nals.insert(0, ps._sps)
+            au = b"".join(b"\x00\x00\x00\x01" + n for n in nals)
+            self._queue_frame(ps, "h264", au, ts)
+
+    def _h264_nal(self, ps: DemuxStream, nal: bytes) -> None:
+        if not nal:
+            return
+        t = nal[0] & 0x1F
+        if t == 7:
+            ps._sps = nal
+        elif t == 8:
+            ps._pps = nal
+        ps._nals.append(nal)
+
     def _queue_jpeg(self, ps: DemuxStream, jfif: bytes,
                     ts: int) -> None:
+        self._queue_frame(ps, "jpeg", jfif, ts)
+
+    def _queue_frame(self, ps: DemuxStream, kind: str, data: bytes,
+                     ts: int) -> None:
         with self._lock:
             if ps._removed or ps.finished:
                 return
-            ps._jpegs.append((jfif, ts))
+            ps._jpegs.append((kind, data, ts))
             if len(ps._jpegs) > ps._max_pending:   # live: newest wins
                 ps._jpegs.popleft()
                 ps.frames_dropped += 1
@@ -657,10 +819,13 @@ class RtspDemux:
                     ps._finish(ps.error)        # actions outside it
                     self._retire(ps)
                 continue
-            jfif, ts = item
+            kind, data, ts = item
             if not ps._removed:
-                img = cv2.imdecode(
-                    np.frombuffer(jfif, np.uint8), cv2.IMREAD_COLOR)
+                if kind == "h264":
+                    img = _decode_h264_au(data)
+                else:
+                    img = cv2.imdecode(
+                        np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
                 if img is not None:
                     ps._seq += 1
                     ps._emit(FrameEvent(

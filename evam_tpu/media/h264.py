@@ -25,6 +25,8 @@ per the spec's consecutive-IDR rule.
 
 from __future__ import annotations
 
+import struct
+
 import numpy as np
 
 
@@ -231,3 +233,78 @@ def write_annexb(path: str, frames, fps: float = 30.0) -> str:
     with open(path, "wb") as fh:
         fh.write(data)
     return path
+
+
+# ------------------------------------------------- RFC 6184 (H.264/RTP)
+
+def split_annexb(data: bytes) -> list[bytes]:
+    """Split an Annex-B buffer into raw NAL units (start codes
+    stripped). Accepts 3- and 4-byte start codes."""
+    nals = []
+    i = 0
+    n = len(data)
+    # find first start code
+    while i < n:
+        if data[i:i + 4] == b"\x00\x00\x00\x01":
+            i += 4
+            break
+        if data[i:i + 3] == b"\x00\x00\x01":
+            i += 3
+            break
+        i += 1
+    start = i
+    while i < n:
+        if data[i:i + 4] == b"\x00\x00\x00\x01":
+            nals.append(data[start:i])
+            i += 4
+            start = i
+        elif data[i:i + 3] == b"\x00\x00\x01":
+            nals.append(data[start:i])
+            i += 3
+            start = i
+        else:
+            i += 1
+    if start < n:
+        nals.append(data[start:])
+    return [x for x in nals if x]
+
+
+def packetize_rfc6184(access_unit: bytes, seq: int, timestamp: int,
+                      ssrc: int, pt: int = 96,
+                      mtu: int = 1400) -> tuple[list[bytes], int]:
+    """RFC 6184 packetization-mode 1: one Annex-B access unit →
+    RTP packets (single NAL unit packets, FU-A fragmentation for
+    NALs over the MTU). Marker set on the AU's last packet.
+    Returns (packets, next_seq)."""
+    nals = split_annexb(access_unit)
+    packets: list[bytes] = []
+
+    def rtp(payload: bytes, marker: bool, s: int) -> bytes:
+        return struct.pack(
+            ">BBHII", 0x80, (0x80 if marker else 0) | pt,
+            s & 0xFFFF, timestamp & 0xFFFFFFFF, ssrc) + payload
+
+    for k, nal in enumerate(nals):
+        last_nal = k == len(nals) - 1
+        if len(nal) <= mtu:
+            packets.append(rtp(nal, last_nal, seq))
+            seq += 1
+            continue
+        # FU-A (§5.8): indicator carries NRI+type 28; header carries
+        # S/E bits + original NAL type
+        indicator = (nal[0] & 0x60) | 28
+        nal_type = nal[0] & 0x1F
+        body = nal[1:]
+        off = 0
+        while off < len(body):
+            frag = body[off:off + mtu]
+            first = off == 0
+            off += len(frag)
+            end = off >= len(body)
+            fu_header = (0x80 if first else 0) | (0x40 if end else 0) \
+                | nal_type
+            packets.append(rtp(
+                bytes([indicator, fu_header]) + frag,
+                last_nal and end, seq))
+            seq += 1
+    return packets, seq

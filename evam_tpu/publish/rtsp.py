@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from evam_tpu.media.h264 import packetize_rfc6184
 from evam_tpu.obs import get_logger
 
 log = get_logger("publish.rtsp")
@@ -113,15 +114,19 @@ def packetize_jpeg(jpeg: bytes, seq: int, timestamp: int, ssrc: int):
 # --------------------------------------------------------------- relay
 
 class FrameRelay:
-    """Latest-frame mailbox for one mount: pipeline pushes JPEGs,
-    client threads block for the next one (slow clients skip frames —
-    live semantics, never backpressure into the pipeline)."""
+    """Latest-frame mailbox for one mount: pipeline pushes encoded
+    frames (JPEGs, or Annex-B H.264 access units for ``codec='h264'``
+    mounts), client threads block for the next one (slow clients skip
+    frames — live semantics, never backpressure into the pipeline)."""
 
     #: RFC 2435 encodes dimensions as blocks/8 in one byte → 2040 max.
     MAX_DIM = 2040
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, codec: str = "jpeg"):
+        if codec not in ("jpeg", "h264"):
+            raise ValueError(f"unsupported RTSP mount codec {codec!r}")
         self.path = path
+        self.codec = codec
         self._cond = threading.Condition()
         self._jpeg: bytes | None = None
         self._gen = 0
@@ -147,6 +152,12 @@ class FrameRelay:
             self._jpeg = jpeg
             self._gen += 1
             self._cond.notify_all()
+
+    def push_annexb(self, access_unit: bytes) -> None:
+        """H.264 mounts: one self-contained Annex-B access unit
+        (SPS+PPS+IDR for intra-only streams, e.g. media/h264.py
+        output sliced per frame)."""
+        self.push_jpeg(access_unit)   # same mailbox, codec-tagged mount
 
     def push_bgr(self, frame_bgr: np.ndarray, quality: int = 80) -> None:
         import cv2
@@ -208,12 +219,19 @@ class RtspServer:
         if self._sock is not None:
             self._sock.close()
 
-    def mount(self, path: str) -> FrameRelay:
+    def mount(self, path: str, codec: str = "jpeg") -> FrameRelay:
         path = path.strip("/")
         with self._lock:
             if path not in self._mounts:
-                self._mounts[path] = FrameRelay(path)
-            return self._mounts[path]
+                self._mounts[path] = FrameRelay(path, codec=codec)
+            relay = self._mounts[path]
+            if relay.codec != codec:
+                # pushing H.264 AUs into a JPEG mount (or vice versa)
+                # would serve undecodable packets with no error
+                raise ValueError(
+                    f"mount {path!r} already exists with codec "
+                    f"{relay.codec!r}, requested {codec!r}")
+            return relay
 
     def unmount(self, path: str) -> None:
         with self._lock:
@@ -259,15 +277,24 @@ class RtspServer:
                     self._reply(conn, cseq, extra=(
                         "Public: OPTIONS, DESCRIBE, SETUP, PLAY, TEARDOWN"))
                 elif method == "DESCRIBE":
-                    if self._mounts.get(path) is None:
+                    relay = self._mounts.get(path)
+                    if relay is None:
                         self._reply(conn, cseq, code="404 Not Found")
                         continue
+                    if relay.codec == "h264":
+                        media = (
+                            "m=video 0 RTP/AVP 96\r\n"
+                            "a=rtpmap:96 H264/90000\r\n"
+                            "a=fmtp:96 packetization-mode=1\r\n"
+                        )
+                    else:
+                        media = "m=video 0 RTP/AVP 26\r\n"
                     sdp = (
                         "v=0\r\n"
                         f"o=- 0 0 IN IP4 {self.host}\r\n"
                         "s=evam-tpu\r\n"
                         "t=0 0\r\n"
-                        "m=video 0 RTP/AVP 26\r\n"
+                        + media +
                         "c=IN IP4 0.0.0.0\r\n"
                         "a=control:streamid=0\r\n"
                     )
@@ -317,7 +344,11 @@ class RtspServer:
                 if jpeg is None:
                     continue
                 ts = int((time.monotonic() - t0) * RTP_CLOCK)
-                packets, seq = packetize_jpeg(jpeg, seq, ts, ssrc)
+                if relay.codec == "h264":
+                    packets, seq = packetize_rfc6184(
+                        jpeg, seq, ts, ssrc)
+                else:
+                    packets, seq = packetize_jpeg(jpeg, seq, ts, ssrc)
                 try:
                     for pkt in packets:
                         # interleaved framing: '$', channel 0, length
