@@ -801,6 +801,8 @@ class RtspDemux:
     def _decode_loop(self) -> None:
         import cv2
 
+        from evam_tpu.media.h264 import decode_ipcm_au
+
         while True:
             ps = self._ready.get()
             if ps is None:
@@ -822,7 +824,12 @@ class RtspDemux:
             kind, data, ts = item
             if not ps._removed:
                 if kind == "h264":
-                    img = _decode_h264_au(data)
+                    # fast path first: our own I_PCM dialect decodes
+                    # in one numpy stride pass; anything else (real
+                    # cameras' CAVLC) falls to the file shim
+                    img = decode_ipcm_au(data)
+                    if img is None:
+                        img = _decode_h264_au(data)
                 else:
                     img = cv2.imdecode(
                         np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)

@@ -235,37 +235,164 @@ def write_annexb(path: str, frames, fps: float = 30.0) -> str:
     return path
 
 
+# ----------------------------------------------------- I_PCM fast decode
+
+class _BitReader:
+    def __init__(self, data: bytes):
+        self._d = data
+        self.pos = 0               # bit position
+
+    def u(self, bits: int) -> int:
+        v = 0
+        for _ in range(bits):
+            byte = self._d[self.pos >> 3]
+            v = (v << 1) | ((byte >> (7 - (self.pos & 7))) & 1)
+            self.pos += 1
+        return v
+
+    def ue(self) -> int:
+        zeros = 0
+        while self.u(1) == 0:
+            zeros += 1
+            if zeros > 31:
+                raise ValueError("bad Exp-Golomb")
+        return (1 << zeros) - 1 + (self.u(zeros) if zeros else 0)
+
+    def se(self) -> int:
+        k = self.ue()
+        return (k + 1) // 2 if k % 2 else -(k // 2)
+
+    def align(self) -> None:
+        self.pos = (self.pos + 7) & ~7
+
+
+def _unescape(rbsp: bytes) -> bytes:
+    """Inverse of emulation prevention: 00 00 03 → 00 00.
+    Left-to-right non-overlapping replace is the exact inverse of the
+    escaper's left-to-right insertion."""
+    return rbsp.replace(b"\x00\x00\x03", b"\x00\x00")
+
+
+def decode_ipcm_au(au: bytes) -> "np.ndarray | None":
+    """From-scratch decoder for the intra-only I_PCM streams THIS
+    module emits (every MB is ``mb_type 25``, so the slice body is a
+    deterministic 2-byte-header + 384-byte-payload lattice that numpy
+    can lift in one stride pass — no per-MB Python loop).
+
+    Why it exists: the only general H.264 decoder in this image is
+    cv2's bundled FFmpeg behind a per-AU temp-file open
+    (``demux._decode_h264_au``), which costs ~100 ms per frame.
+    Loopback fan-in of our own re-streams should not pay that.
+    Returns BGR [H, W, 3], or None when the AU is not this exact
+    dialect (caller falls back to the general shim — real cameras'
+    CAVLC scans land there)."""
+    import cv2
+
+    sps_nal = idr_nal = None
+    for nal in split_annexb(au):
+        t = nal[0] & 0x1F
+        if t == 7:
+            sps_nal = nal
+        elif t == 5:
+            idr_nal = nal
+    if sps_nal is None or idr_nal is None:
+        return None
+    try:
+        r = _BitReader(_unescape(sps_nal[1:]))
+        if r.u(8) != 66:                   # baseline, as we write it
+            return None
+        r.u(16)                            # constraint flags + level
+        r.ue()                             # sps id
+        r.ue()                             # log2_max_frame_num_minus4
+        if r.ue() != 2:                    # pic_order_cnt_type
+            return None
+        r.ue()                             # max_num_ref_frames
+        r.u(1)                             # gaps allowed
+        mbs_w = r.ue() + 1
+        mbs_h = r.ue() + 1
+        r.u(1)                             # frame_mbs_only
+        r.u(1)                             # direct_8x8
+        crop_r = crop_b = 0
+        if r.u(1):                         # frame_cropping_flag
+            r.ue()                         # left
+            crop_r = r.ue() * 2
+            r.ue()                         # top
+            crop_b = r.ue() * 2
+
+        body = _unescape(idr_nal[1:])
+        s = _BitReader(body)
+        s.ue()                             # first_mb_in_slice
+        if s.ue() != 7:                    # slice_type I (all)
+            return None
+        s.ue()                             # pps id
+        s.u(4)                             # frame_num (log2 max = 4)
+        s.ue()                             # idr_pic_id
+        s.u(2)                             # no_output + long_term
+        s.se()                             # slice_qp_delta
+        if s.ue() != 25:                   # first MB must be I_PCM
+            return None
+        s.align()
+        o0 = s.pos >> 3
+    except (IndexError, ValueError):
+        return None
+
+    n_mbs = mbs_w * mbs_h
+    need = o0 + (n_mbs - 1) * 386 + 384
+    if len(body) < need:
+        return None
+    arr = np.frombuffer(body, np.uint8, count=need)
+    if n_mbs > 1:
+        heads = arr[o0 + 384:need].reshape(n_mbs - 1, 386)[:, :2]
+        # every inter-MB header is ue(25)+align = 0x0D 0x00
+        if not (np.all(heads[:, 0] == 0x0D)
+                and np.all(heads[:, 1] == 0x00)):
+            return None
+    starts = o0 + 386 * np.arange(n_mbs)
+    payload = arr[starts[:, None] + np.arange(384)]
+    y = (payload[:, :256].reshape(mbs_h, mbs_w, 16, 16)
+         .transpose(0, 2, 1, 3).reshape(mbs_h * 16, mbs_w * 16))
+    u = (payload[:, 256:320].reshape(mbs_h, mbs_w, 8, 8)
+         .transpose(0, 2, 1, 3).reshape(mbs_h * 8, mbs_w * 8))
+    v = (payload[:, 320:].reshape(mbs_h, mbs_w, 8, 8)
+         .transpose(0, 2, 1, 3).reshape(mbs_h * 8, mbs_w * 8))
+    ch, cw = mbs_h * 16 - crop_b, mbs_w * 16 - crop_r
+    if ch <= 0 or cw <= 0:
+        return None          # nonsense crop: not our dialect
+    # standard I420 planar buffer → one cv2 colorspace call
+    planar = np.concatenate([
+        y.reshape(-1),
+        u.reshape(-1),
+        v.reshape(-1),
+    ]).reshape(mbs_h * 24, mbs_w * 16)
+    bgr = cv2.cvtColor(planar, cv2.COLOR_YUV2BGR_I420)
+    return np.ascontiguousarray(bgr[:ch, :cw])
+
+
 # ------------------------------------------------- RFC 6184 (H.264/RTP)
 
 def split_annexb(data: bytes) -> list[bytes]:
     """Split an Annex-B buffer into raw NAL units (start codes
-    stripped). Accepts 3- and 4-byte start codes."""
+    stripped). Accepts 3- and 4-byte start codes. Scans with
+    ``bytes.find`` — emulation prevention guarantees no start code
+    inside a NAL payload, and a byte-by-byte Python loop costs
+    ~700 ms on a 3 MB 1080p I_PCM access unit."""
     nals = []
-    i = 0
-    n = len(data)
-    # find first start code
-    while i < n:
-        if data[i:i + 4] == b"\x00\x00\x00\x01":
-            i += 4
+    i = data.find(b"\x00\x00\x01")
+    if i < 0:
+        return []
+    pos = i + 3
+    while True:
+        j = data.find(b"\x00\x00\x01", pos)
+        if j < 0:
+            nals.append(data[pos:])
             break
-        if data[i:i + 3] == b"\x00\x00\x01":
-            i += 3
-            break
-        i += 1
-    start = i
-    while i < n:
-        if data[i:i + 4] == b"\x00\x00\x00\x01":
-            nals.append(data[start:i])
-            i += 4
-            start = i
-        elif data[i:i + 3] == b"\x00\x00\x01":
-            nals.append(data[start:i])
-            i += 3
-            start = i
-        else:
-            i += 1
-    if start < n:
-        nals.append(data[start:])
+        end = j
+        # a 4-byte start code (00 00 00 01) leaves one zero before
+        # the match; RBSP trailing bits keep real NAL tails nonzero
+        if end > pos and data[end - 1] == 0:
+            end -= 1
+        nals.append(data[pos:end])
+        pos = j + 3
     return [x for x in nals if x]
 
 

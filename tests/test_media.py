@@ -874,3 +874,40 @@ class TestRtspDemux:
         finally:
             dmx._queue_frame = type(dmx)._queue_frame.__get__(dmx)
             dmx.stop()
+
+    def test_ipcm_fast_decoder_matches_ffmpeg(self, tmp_path):
+        """media/h264.decode_ipcm_au — the from-scratch stride-pass
+        decoder for our own I_PCM dialect — must agree with FFmpeg's
+        decode of the same access unit (I_PCM carries raw samples, so
+        the only difference is YUV→BGR rounding)."""
+        import cv2
+
+        from evam_tpu.media import h264
+
+        f = np.zeros((96, 128, 3), np.uint8)
+        f[:, :] = (40, 90, 160)
+        f[20:60, 30:70] = (200, 60, 30)
+        au = h264.encode_frames([f])
+        fast = h264.decode_ipcm_au(au)
+        assert fast is not None and fast.shape == (96, 128, 3)
+        p = str(tmp_path / "au.h264")
+        with open(p, "wb") as fh:
+            fh.write(au)
+        cap = cv2.VideoCapture(p)
+        ok, ref = cap.read()
+        cap.release()
+        assert ok
+        err = float(np.abs(fast.astype(int) - ref.astype(int)).mean())
+        assert err < 1.5, err
+
+    def test_ipcm_fast_decoder_crop_and_fallback(self):
+        from evam_tpu.media import h264
+
+        # non-16-multiple frame: SPS crop honored
+        f = np.full((120, 64, 3), 90, np.uint8)
+        img = h264.decode_ipcm_au(h264.encode_frames([f]))
+        assert img is not None and img.shape == (120, 64, 3)
+        # anything that isn't our exact I_PCM dialect returns None
+        # (the demux then falls to the FFmpeg file shim)
+        assert h264.decode_ipcm_au(b"\x00\x00\x00\x01\x67\xff") is None
+        assert h264.decode_ipcm_au(b"garbage") is None
