@@ -314,9 +314,13 @@ def decode_ipcm_au(au: bytes) -> "np.ndarray | None":
         r.u(1)                             # direct_8x8
         crop_r = crop_b = 0
         if r.u(1):                         # frame_cropping_flag
-            r.ue()                         # left
+            # our encoder only crops right/bottom — any other crop is
+            # a foreign stream and must take the general decoder
+            if r.ue() != 0:                # left
+                return None
             crop_r = r.ue() * 2
-            r.ue()                         # top
+            if r.ue() != 0:                # top
+                return None
             crop_b = r.ue() * 2
 
         body = _unescape(idr_nal[1:])

@@ -200,6 +200,13 @@ class DecodePool:
         for t in self._threads:
             t.join(timeout=10)
 
+    def stats(self) -> dict:
+        """Worker/stream counts for /healthz (same shape family as
+        ``RtspDemux.stats``)."""
+        with self._cv:
+            streams = len(self._heap)
+        return {"workers": len(self._threads), "queued_streams": streams}
+
     # -------------------------------------------------------- workers
 
     def _work(self) -> None:

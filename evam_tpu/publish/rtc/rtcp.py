@@ -196,7 +196,8 @@ def parse_feedback(compound: bytes, media_ssrc: int | None = None) -> dict:
     retransmission/keyframes for an SSRC it is not receiving.
     """
     out: dict = {"nack": [], "pli": False, "fir": False,
-                 "fraction_lost": None, "highest_seq": None}
+                 "fraction_lost": None, "highest_seq": None,
+                 "jitter": None, "lsr": None, "dlsr": None}
     i = 0
     while i + 4 <= len(compound):
         first, pt, length_w = struct.unpack(
@@ -216,8 +217,9 @@ def parse_feedback(compound: bytes, media_ssrc: int | None = None) -> dict:
                 block_ssrc = struct.unpack("!I", body[j:j + 4])[0]
                 if want is None or block_ssrc == want:
                     out["fraction_lost"] = body[j + 4] / 256.0
-                    out["highest_seq"] = struct.unpack(
-                        "!I", body[j + 8:j + 12])[0]
+                    (out["highest_seq"], out["jitter"], out["lsr"],
+                     out["dlsr"]) = struct.unpack(
+                        "!IIII", body[j + 8:j + 24])
                     break
         elif pt in (PT_RTPFB, PT_PSFB) and len(body) >= 4:
             fb_media = struct.unpack("!I", body[:4])[0]
@@ -255,9 +257,12 @@ def parse_feedback(compound: bytes, media_ssrc: int | None = None) -> dict:
 
 def receiver_report(sender_ssrc: int, media_ssrc: int,
                     fraction_lost: float, cumulative_lost: int,
-                    highest_seq: int) -> bytes:
+                    highest_seq: int, jitter: int = 0,
+                    lsr: int = 0, dlsr: int = 0) -> bytes:
     """RR with one report block — the packet a receiving peer sends;
-    here it is the test viewer's way to exercise RR-driven recovery."""
+    here it is the test viewer's way to exercise RR-driven recovery.
+    ``jitter`` is in RTP clock units; ``lsr``/``dlsr`` echo the last
+    SR per RFC 3550 §6.4.1 (the sender derives RTT from them)."""
     fl = min(255, max(0, int(fraction_lost * 256)))
     return struct.pack(
         "!BBHI I BBH IIII",
@@ -265,7 +270,7 @@ def receiver_report(sender_ssrc: int, media_ssrc: int,
         media_ssrc & 0xFFFFFFFF,
         fl, (cumulative_lost >> 16) & 0xFF, cumulative_lost & 0xFFFF,
         highest_seq & 0xFFFFFFFF,
-        0, 0, 0,  # jitter, LSR, DLSR
+        jitter & 0xFFFFFFFF, lsr & 0xFFFFFFFF, dlsr & 0xFFFFFFFF,
     )
 
 

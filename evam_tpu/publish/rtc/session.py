@@ -168,6 +168,11 @@ class RtcSession:
         self.fps_scale_min = 0.25
         self.rate_adaptations = 0
         self._lossy_rrs = 0
+        #: RTT from the RR's LSR/DLSR echo (RFC 3550 §6.4.1) and the
+        #: receiver's interarrival jitter, both surfaced as session
+        #: stats for monitoring (None until a compliant RR arrives)
+        self.last_rtt_ms: float | None = None
+        self.last_jitter_ms: float | None = None
         #: give up (and fire on_dead → relay release) if no viewer
         #: completes ICE+DTLS in this window — an unreachable host
         #: candidate must not pin encode cost forever
@@ -377,6 +382,15 @@ class RtcSession:
                 want_key = True
         if want_key:
             self._force_key = True
+        if fb["jitter"] is not None:
+            self.last_jitter_ms = fb["jitter"] / 90.0   # 90 kHz clock
+        if fb["lsr"]:
+            # RTT = now_ntp_mid32 − LSR − DLSR (1/65536 s units)
+            sec, frac = rtcp.ntp_now()
+            mid = ((sec & 0xFFFF) << 16) | (frac >> 16)
+            units = (mid - fb["lsr"] - (fb["dlsr"] or 0)) & 0xFFFFFFFF
+            if units < 0x80000000:          # sane (non-wrapped) value
+                self.last_rtt_ms = units * 1000.0 / 65536.0
         # ---- rate adaptation: two consecutive lossy RRs halve the
         # frame rate (AIMD-flavored: multiplicative decrease, gentle
         # multiplicative recovery on clean reports)

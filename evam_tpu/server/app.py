@@ -131,6 +131,13 @@ def build_app(
 
     async def healthz(request: web.Request) -> web.Response:
         ready = registry.hub.readiness()
+        # shared-ingest visibility: the demux/pool serve EVERY live
+        # stream — a monitoring consumer needs their frame counters
+        # next to engine readiness
+        if registry.rtsp_demux is not None:
+            ready["rtsp_demux"] = registry.rtsp_demux.stats()
+        if registry.decode_pool is not None:
+            ready["decode_pool"] = registry.decode_pool.stats()
         if ready.get("stalled"):
             # 503 so HTTP-status readiness probes (helm chart httpGet)
             # actually take the pod out of rotation

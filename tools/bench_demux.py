@@ -78,14 +78,19 @@ def main() -> int:
         ]
 
     def feeder(relay):
+        # deadline pacing: sleep(1/fps) per cycle would drift the
+        # offered rate below nominal (push time + 64-thread
+        # contention), flattering decoded/offered comparisons
         k = 0
+        next_t = time.monotonic()
         while not stop.is_set():
             if args.codec == "h264":
                 relay.push_annexb(payloads[k % len(payloads)])
             else:
                 relay.push_jpeg(payloads[k % len(payloads)])
             k += 1
-            time.sleep(1 / args.fps)
+            next_t += 1 / args.fps
+            time.sleep(max(0.0, next_t - time.monotonic()))
 
     for i in range(args.streams):
         relay = srv.mount(f"cam{i}", codec=args.codec)
