@@ -37,6 +37,13 @@ def ntp_now() -> tuple[int, int]:
     return sec & 0xFFFFFFFF, frac
 
 
+def ntp_mid32() -> int:
+    """The middle 32 bits of the NTP timestamp (low 16 of seconds,
+    high 16 of fraction) — the LSR/DLSR unit of RFC 3550 §6.4.1."""
+    sec, frac = ntp_now()
+    return ((sec & 0xFFFF) << 16) | (frac >> 16)
+
+
 def sender_report(ssrc: int, rtp_ts: int, packets: int,
                   octets: int, cname: str = "evam-tpu") -> bytes:
     """Compound SR + SDES(CNAME)."""
@@ -186,7 +193,10 @@ PT_PSFB = 206    # payload-specific feedback (FMT 1 = PLI, 4 = FIR)
 def parse_feedback(compound: bytes, media_ssrc: int | None = None) -> dict:
     """Walk a plaintext RTCP compound and pull out what the sender
     acts on: ``{"nack": [seq…], "pli": bool, "fir": bool,
-    "fraction_lost": float|None, "highest_seq": int|None}``.
+    "fraction_lost": float|None, "highest_seq": int|None,
+    "jitter": int|None (RTP clock units), "lsr": int|None,
+    "dlsr": int|None}`` (the last two in 1/65536 s, RFC 3550
+    §6.4.1 — RTT inputs).
 
     NACK FCI entries are (PID, BLP) pairs (RFC 4585 §6.2.1): PID is a
     lost packet, each set bit i of BLP marks PID+i+1 lost too.

@@ -386,9 +386,8 @@ class RtcSession:
             self.last_jitter_ms = fb["jitter"] / 90.0   # 90 kHz clock
         if fb["lsr"]:
             # RTT = now_ntp_mid32 − LSR − DLSR (1/65536 s units)
-            sec, frac = rtcp.ntp_now()
-            mid = ((sec & 0xFFFF) << 16) | (frac >> 16)
-            units = (mid - fb["lsr"] - (fb["dlsr"] or 0)) & 0xFFFFFFFF
+            units = (rtcp.ntp_mid32() - fb["lsr"]
+                     - (fb["dlsr"] or 0)) & 0xFFFFFFFF
             if units < 0x80000000:          # sane (non-wrapped) value
                 self.last_rtt_ms = units * 1000.0 / 65536.0
         # ---- rate adaptation: two consecutive lossy RRs halve the

@@ -1009,9 +1009,7 @@ class TestLossRecovery:
             assert viewer.media
             # craft an RR as a compliant receiver would: LSR = the
             # SR's NTP mid-32 50 ms ago, DLSR = 20 ms hold time
-            sec, frac = rtcp.ntp_now()
-            mid = ((sec & 0xFFFF) << 16) | (frac >> 16)
-            lsr = (mid - int(0.05 * 65536)) & 0xFFFFFFFF
+            lsr = (rtcp.ntp_mid32() - int(0.05 * 65536)) & 0xFFFFFFFF
             viewer.send_feedback(rtcp.receiver_report(
                 viewer.ssrc, sess.ssrc, fraction_lost=0.0,
                 cumulative_lost=0, highest_seq=max(viewer.seqs()),
