@@ -408,9 +408,22 @@ class RtspDemux:
         ps.sock = sock
         ps._buf.extend(residue)   # interleaved data behind the PLAY 200
         with self._lock:
+            # re-check: stop() may have run during the blocking
+            # handshake — registering on a closed selector raises
+            # ValueError and would leak the registry entry
+            if self._stop.is_set():
+                sock.close()
+                raise RuntimeError("demux is stopped")
             self._streams.append(ps)
-        self._sel.register(sock, selectors.EVENT_READ, ps)
-        self._wake_w.send(b"x")
+        try:
+            self._sel.register(sock, selectors.EVENT_READ, ps)
+            self._wake_w.send(b"x")
+        except (ValueError, KeyError, OSError) as exc:
+            with self._lock:
+                if ps in self._streams:
+                    self._streams.remove(ps)
+            sock.close()
+            raise RuntimeError(f"demux is stopping: {exc}") from exc
         return ps
 
     def stop(self) -> None:

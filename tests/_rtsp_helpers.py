@@ -1,0 +1,43 @@
+"""Shared RTSP camera-simulator scaffolding for tests.
+
+One definition of the "N paced live cameras" test server used by the
+demux tests (tests/test_media.py) and the live-resume test
+(tests/test_server.py): an RtspServer with ``n`` mounts, each fed by
+a daemon thread pushing a per-stream-identified, per-frame-ramped BGR
+frame at ``fps``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+
+def start_camera_server(n_streams: int, fps: float = 15.0,
+                        size: tuple[int, int] = (96, 128)):
+    """Returns ``(srv, stop_event)``; set the event to halt feeders,
+    then call ``srv.stop()``."""
+    from evam_tpu.publish.rtsp import RtspServer
+
+    srv = RtspServer(port=0, host="127.0.0.1")
+    srv.start()
+    stop = threading.Event()
+    h, w = size
+
+    def feeder(relay, i):
+        k = 0
+        while not stop.is_set():
+            f = np.zeros((h, w, 3), np.uint8)
+            f[:, :, 2] = (20 * i) % 256   # per-stream identity
+            f[:, :, 1] = (k * 8) % 256    # per-frame ramp (order)
+            relay.push_bgr(f)
+            k += 1
+            time.sleep(1 / fps)
+
+    for i in range(n_streams):
+        threading.Thread(
+            target=feeder, args=(srv.mount(f"cam{i}"), i),
+            daemon=True).start()
+    return srv, stop

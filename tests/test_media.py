@@ -462,32 +462,9 @@ class TestRtspDemux:
 
     @staticmethod
     def _start_server(n_streams, fps=15.0):
-        import threading as th
+        from tests._rtsp_helpers import start_camera_server
 
-        from evam_tpu.publish.rtsp import RtspServer
-
-        srv = RtspServer(port=0, host="127.0.0.1")
-        srv.start()
-        stop = th.Event()
-
-        def feeder(relay, i):
-            k = 0
-            while not stop.is_set():
-                f = np.zeros((96, 128, 3), np.uint8)
-                f[:, :, 2] = 20 * i          # per-stream identity
-                f[:, :, 1] = (k * 8) % 256   # per-frame ramp (order)
-                relay.push_bgr(f)
-                k += 1
-                time.sleep(1 / fps)
-
-        threads = [
-            th.Thread(target=feeder, args=(srv.mount(f"cam{i}"), i),
-                      daemon=True)
-            for i in range(n_streams)
-        ]
-        for t in threads:
-            t.start()
-        return srv, stop
+        return start_camera_server(n_streams, fps=fps)
 
     def test_paced_streams_share_bounded_threads(self):
         import threading as th
@@ -911,3 +888,74 @@ class TestRtspDemux:
         # (the demux then falls to the FFmpeg file shim)
         assert h264.decode_ipcm_au(b"\x00\x00\x00\x01\x67\xff") is None
         assert h264.decode_ipcm_au(b"garbage") is None
+
+    def test_demux_churn_add_close_stop_race(self):
+        """Concurrent add/close from several threads while streams
+        flow, then stop() fired while every worker is mid-loop (gated
+        on observed progress, not wall clock): no deadlock, the
+        add-vs-stop race surfaces as the documented RuntimeError,
+        every stream terminates with EOS, the registry drains."""
+        import threading as th
+
+        from evam_tpu.media.demux import RtspDemux
+
+        srv, stop_feed = self._start_server(4, fps=30.0)
+        dmx = RtspDemux(decode_workers=2)
+        errors: list = []
+        streams: list = []
+        lock = th.Lock()
+        progressed = [th.Event() for _ in range(4)]
+
+        def churn(worker_id):
+            for k in range(200):     # stop() ends the loop, not k
+                try:
+                    s = dmx.add_stream(
+                        f"rtsp://127.0.0.1:{srv.port}/cam{k % 4}",
+                        stream_id=f"w{worker_id}-{k}")
+                except RuntimeError:
+                    return           # demux stopped mid-add: the
+                                     # documented race outcome
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(exc)
+                    return
+                with lock:
+                    streams.append(s)
+                if k >= 1:
+                    progressed[worker_id].set()
+                try:
+                    it = s.frames()
+                    next(it, None)   # consume one frame
+                    s.close()
+                    for _ in it:     # drain to EOS
+                        pass
+                except Exception as exc:  # noqa: BLE001 — nothing
+                    # after a successful add may raise, stop or not
+                    with lock:
+                        errors.append(exc)
+                    return
+
+        workers = [th.Thread(target=churn, args=(i,), daemon=True)
+                   for i in range(4)]
+        try:
+            for t in workers:
+                t.start()
+            # stop only once every worker is demonstrably mid-churn
+            for ev in progressed:
+                assert ev.wait(timeout=30), "worker never progressed"
+            dmx.stop()
+            for t in workers:
+                t.join(timeout=20)
+            assert all(not t.is_alive() for t in workers), "churn hung"
+            assert not errors, errors
+            # every stream that was created terminated
+            deadline = time.time() + 5
+            while time.time() < deadline and not all(
+                    s.finished for s in streams):
+                time.sleep(0.05)
+            assert all(s.finished for s in streams)
+            assert dmx.stats()["streams"] == 0
+        finally:
+            stop_feed.set()
+            dmx.stop()
+            srv.stop()

@@ -273,3 +273,65 @@ class TestStageStatePersistence:
         # restored BEFORE the stream started: first new id >= high water
         assert track2.tracker._next_id >= high_water
         reg2.stop_all()
+
+
+class TestDemuxResume:
+    @pytest.mark.slow
+    def test_live_rtsp_stream_resumes_through_demux(
+            self, tmp_path_factory):
+        """Crash-resume (SURVEY §5.4) for a live demux-routed stream:
+        a persisted rtsp:// instance re-attaches through the shared
+        demux on the next boot and keeps producing frames. Slow: two
+        full pipeline boots over live RTSP — the fast suite's <90 s
+        budget excludes it."""
+        from tests._rtsp_helpers import start_camera_server
+
+        srv, stop_feed = start_camera_server(1, fps=15.0,
+                                             size=(96, 96))
+
+        state_dir = tmp_path_factory.mktemp("demuxstate")
+        settings = Settings(
+            pipelines_dir=str(REPO / "pipelines"),
+            state_dir=str(state_dir),
+            rtsp_demux_workers=1,
+        )
+        model_registry = ModelRegistry(
+            dtype="float32", input_overrides=SMALL,
+            width_overrides=NARROW)
+        hub = EngineHub(model_registry, plan=build_mesh(),
+                        max_batch=16, deadline_ms=4.0)
+        reg = PipelineRegistry(settings, hub=hub)
+        body = {
+            "source": {"uri": f"rtsp://127.0.0.1:{srv.port}/cam0",
+                       "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+            "parameters": {"detection-properties": {"threshold": 0.0}},
+        }
+        try:
+            inst = reg.start_instance(
+                "object_detection", "person_vehicle_bike", body)
+            deadline = time.time() + 120
+            while time.time() < deadline and (
+                    inst._runner is None or not inst._runner.frames_out):
+                time.sleep(0.1)
+            assert inst._runner and inst._runner.frames_out > 0
+            reg.stop_all()       # persists; keeps streams.json
+
+            reg2 = PipelineRegistry(settings, hub=hub)
+            assert reg2.resume() == 1
+            inst2 = next(iter(reg2.instances.values()))
+            deadline = time.time() + 120
+            while time.time() < deadline and (
+                    inst2._runner is None
+                    or not inst2._runner.frames_out):
+                time.sleep(0.1)
+            assert inst2._runner and inst2._runner.frames_out > 0, \
+                "resumed stream produced no frames through the demux"
+            assert inst2.state.value == "RUNNING"
+            # it really is on the demux: the shared selector serves it
+            assert reg2.rtsp_demux is not None
+            assert reg2.rtsp_demux.stats()["streams"] == 1
+            reg2.stop_all()
+        finally:
+            stop_feed.set()
+            srv.stop()
