@@ -48,6 +48,25 @@ def test_bench_healthy_cpu_run_emits_contract_line():
     assert {"batch", "depth", "p50_ms", "p99_ms"} <= set(data)
 
 
+def test_bench_serialize_compile_serve_emits_contract_line():
+    """--serialize-compile (the wedge-proof serve-battery mode) must
+    complete the SERVE path — the only config that reaches the
+    engine's devlock spans — with the global lock engaged end to end
+    (a deadlock here would hang the r5 battery's serve_safe entry)."""
+    r = _run_bench(
+        ["--config", "serve", "--streams", "2", "--seconds", "4",
+         "--batch", "4", "--stall-timeout", "120",
+         "--serialize-compile"],
+        {"BENCH_PLATFORM": "cpu"},
+        timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    data = _assert_contract(r)
+    assert data["metric"] == "serve_streams_30fps_per_chip"
+    assert data["errors"] == 0
+    assert data["dead_streams"] == 0
+
+
 def test_bench_unreachable_device_still_emits_contract_line():
     """A dead/wedged backend must produce a parseable failure line,
     not a traceback (bench.py fail_line)."""
