@@ -207,7 +207,11 @@ def run_serve_bench(args) -> dict:
     from evam_tpu.server.registry import PipelineRegistry
 
     repo = pathlib.Path(__file__).resolve().parent
-    settings = Settings(pipelines_dir=str(repo / "pipelines"))
+    settings = Settings(
+        pipelines_dir=str(repo / "pipelines"),
+        rtsp_demux_workers=(
+            args.demux_workers if args.serve_ingest == "rtsp" else 0),
+    )
     registry = ModelRegistry(
         models_dir=args.models_dir,
         dtype="int8" if args.precision == "int8" else "bfloat16")
@@ -232,6 +236,42 @@ def run_serve_bench(args) -> dict:
         "mqtt": {"type": "mqtt", "host": "127.0.0.1", "port": 1883,
                  "topic": "evam/serve_bench"},
     }[args.serve_publish]
+
+    # live-RTSP loopback ingest: an in-process camera farm paced at
+    # 30 fps feeding the async demux — the config-5 ingest shape
+    cam_srv = None
+    cam_stop = None
+    if args.serve_ingest == "rtsp":
+        import threading as _th
+
+        import numpy as _np
+
+        from evam_tpu.publish.rtsp import RtspServer
+
+        cam_srv = RtspServer(port=0, host="127.0.0.1")
+        cam_srv.start()
+        cam_stop = _th.Event()
+
+        def _feeder(relay, i):
+            k = 0
+            f = _np.zeros((src_h, src_w, 3), _np.uint8)
+            f[:, :, 2] = (13 * i) % 256
+            next_t = time.monotonic()
+            while not cam_stop.is_set():
+                # push_bgr owns the encode (MAX_DIM cap + 8-align);
+                # has_clients skips N×30fps encodes while engines
+                # warm and no demux stream has connected yet
+                if relay.has_clients:
+                    f[:, :, 1] = (k * 9) % 256
+                    relay.push_bgr(f)
+                k += 1
+                next_t += 1 / 30.0
+                time.sleep(max(0.0, next_t - time.monotonic()))
+
+        for i in range(args.streams):
+            _th.Thread(
+                target=_feeder, args=(cam_srv.mount(f"cam{i}"), i),
+                daemon=True).start()
 
     insts = []
     windows: list[dict] = []
@@ -277,11 +317,12 @@ def run_serve_bench(args) -> dict:
             f"{time.perf_counter() - t_warm0:.1f}s")
 
         for i in range(args.streams):
+            if args.serve_ingest == "rtsp":
+                uri = f"rtsp://127.0.0.1:{cam_srv.port}/cam{i}"
+            else:
+                uri = f"synthetic://{src_w}x{src_h}@30?seed={i}"
             insts.append(reg.start_instance(name, version, {
-                "source": {
-                    "uri": f"synthetic://{src_w}x{src_h}@30?seed={i}",
-                    "type": "uri",
-                },
+                "source": {"uri": uri, "type": "uri"},
                 "destination": {"metadata": dest},
             }))
         time.sleep(3.0)  # reach steady state before the clock starts
@@ -336,8 +377,14 @@ def run_serve_bench(args) -> dict:
             k: round(v["items"] / max(1, v["batches"]), 1)
             for k, v in reg.hub.stats().items()
         }
+        demux_stats = (reg.rtsp_demux.stats()
+                       if reg.rtsp_demux is not None else None)
     finally:
+        if cam_stop is not None:
+            cam_stop.set()
         reg.stop_all()  # registry owns hub shutdown (stops engines too)
+        if cam_srv is not None:
+            cam_srv.stop()
 
     best = max(windows, key=lambda wnd: wnd["streams"])
     result_extra = {}
@@ -365,6 +412,7 @@ def run_serve_bench(args) -> dict:
         "engine_item_p50_ms": best["engine_item_p50_ms"],
         "errors": errors,
         "dead_streams": dead,
+        **({"demux": demux_stats} if demux_stats else {}),
     }
 
 
@@ -402,15 +450,22 @@ def main() -> int:
                         "(the reference's detect+track+classify hot "
                         "path by default)")
     p.add_argument(
-        "--serve-ingest", choices=["seed", "host"], default="seed",
+        "--serve-ingest", choices=["seed", "host", "rtsp"], default="seed",
         help="[serve] seed: stages submit per-frame uint32 seeds and "
         "engines synthesize wire batches on-chip "
         "(steps.wrap_device_synth) — the full serving path minus only "
         "the host→device pixel copy (which here rides a ~18 MB/s "
         "tunnel); host: real pixels host-resized+wire-encoded and "
         "transferred per batch (the deployment shape; tunnel-bound in "
-        "this environment)",
+        "this environment); rtsp: every stream is a LIVE camera — an "
+        "in-process RTSP loopback server paces 30 fps JPEG streams "
+        "into the async demux (media/demux.py), the true north-star "
+        "config-5 ingest shape (tunnel-bound here, the deployment "
+        "number on a real TPU VM)",
     )
+    p.add_argument("--demux-workers", type=int, default=2,
+                   help="[serve --serve-ingest rtsp] shared demux "
+                        "decode workers")
     p.add_argument("--serve-publish", choices=["null", "file", "mqtt"],
                    default="null",
                    help="[serve] metadata destination for every stream")
