@@ -23,6 +23,8 @@ ENTRIES = [
     ("serve_b128", "serving path, 64 streams, b128"),
     ("serve_file_32", "serving path, 32 streams, file publish"),
     ("serve_ir", "serving path, 64 streams, manifest IR models"),
+    ("serve_rtsp_8", "serving path, 8 LIVE RTSP streams via the "
+                     "async demux (tunnel-bound pixels)"),
     ("detect_ir", "detect bench, manifest IR person_vehicle_bike"),
     ("detect_int8", "detect bench, int8 quantized modules"),
     ("sweep40", "operating-point sweep @ p99<40ms"),

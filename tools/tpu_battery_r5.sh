@@ -73,6 +73,11 @@ run serve_file_32 700 python bench.py --config serve --streams 32 --seconds 12 \
     --batch 256 --serve-publish file --stall-timeout 180 --serialize-compile
 run serve_ir 700 python bench.py --config serve --streams 64 --seconds 16 \
     --batch 256 --models-dir "$IRDIR" --stall-timeout 180 --serialize-compile
+# live-RTSP ingest through the async demux: tunnel-bound here (real
+# pixels ride the ~18 MB/s link) but the first ever live-path number
+run serve_rtsp_8 700 python bench.py --config serve --serve-ingest rtsp \
+    --streams 8 --seconds 12 --batch 32 --width 640 --height 480 \
+    --stall-timeout 180 --serialize-compile
 
 # 4 ---- the deliberate wedge repro, DEAD LAST (may take the tunnel
 # down — that outcome IS the datum). Unserialized on purpose.
