@@ -360,6 +360,15 @@ def run_serve_bench(args) -> dict:
                 "engine_item_p50_ms": _label_values(
                     metrics.quantiles_by_label(
                         "evam_item_latency_seconds", 0.5), 1),
+                # per-batch host clock through the BatchEngine
+                # (ringbuf.STAGES): slot-write / seal / device_put /
+                # launch / readback attribution, max across engines
+                "host_stage_p50_ms": {
+                    stage: round(v * 1e3, 3)
+                    for stage, v in metrics.quantiles_grouped(
+                        "evam_engine_stage_seconds", 0.5,
+                        "stage").items()
+                },
             })
             wnd = windows[-1]
             log(f"[serve] window: {fps:.0f} FPS total "
@@ -410,6 +419,7 @@ def run_serve_bench(args) -> dict:
         "frames_per_batch": occupancy,
         "stage_p50_ms": best["stage_p50_ms"],
         "engine_item_p50_ms": best["engine_item_p50_ms"],
+        "host_stage_p50_ms": best["host_stage_p50_ms"],
         "errors": errors,
         "dead_streams": dead,
         **({"demux": demux_stats} if demux_stats else {}),
@@ -613,7 +623,13 @@ def main() -> int:
 
     def measure(b: int, depth: int, seconds: float):
         """One operating point: compile, warm, run, return
-        (streams, p50_ms, p99_ms)."""
+        (streams, p50_ms, p99_ms, host_stage_p50_ms). The stage dict
+        attributes the host-side per-batch cost (device_put dispatch,
+        launch dispatch, readback wait) the same way the serving
+        BatchEngine's stage clock does (engine/ringbuf.STAGES)."""
+        put_s: list[float] = []
+        launch_s: list[float] = []
+        rb_s: list[float] = []
         if args.config == "audio":
             wire_shape = (b, 16000)  # 1 s windows at 16 kHz
         elif args.wire == "i420":
@@ -640,7 +656,12 @@ def main() -> int:
                 _fn_cache[b] = jax.jit(seeded_step)
             fn = _fn_cache[b]
             inputs = [np.int32(0), np.int32(1)]
-            submit = lambda i: fn(params, inputs[i % 2])
+
+            def submit(i):
+                t0 = time.perf_counter()
+                out = fn(params, inputs[i % 2])
+                launch_s.append(time.perf_counter() - t0)
+                return out
         else:
             if b not in _fn_cache:
                 _fn_cache[b] = jax.jit(step)
@@ -651,8 +672,15 @@ def main() -> int:
                 rng.integers(0, 255, wire_shape).astype(wire_dtype)
                 for _ in range(2)
             ]
-            submit = lambda i: fn(
-                params, **{input_name: jax.device_put(host_batches[i % 2])})
+
+            def submit(i):
+                t0 = time.perf_counter()
+                dev = jax.device_put(host_batches[i % 2])
+                t1 = time.perf_counter()
+                out = fn(params, **{input_name: dev})
+                put_s.append(t1 - t0)
+                launch_s.append(time.perf_counter() - t1)
+                return out
 
         t0 = time.perf_counter()
         out = submit(0)
@@ -661,6 +689,8 @@ def main() -> int:
             f"{time.perf_counter() - t0:.1f}s; out {out.shape} {out.dtype}")
         for i in range(3):
             jax.block_until_ready(submit(i))
+        # drop warmup/compile samples from the attribution
+        put_s.clear(); launch_s.clear(); rb_s.clear()
 
         # Timed: keep `depth` batches in flight; async dispatch
         # overlaps the host->device copy of batch k+1 with compute of
@@ -677,10 +707,14 @@ def main() -> int:
             batches += 1
             if len(inflight) >= depth:
                 done, t_sub0 = inflight.pop(0)
+                t_rb = time.perf_counter()
                 jax.block_until_ready(done)
+                rb_s.append(time.perf_counter() - t_rb)
                 lat_samples.append(time.perf_counter() - t_sub0)
         for done, t_sub in inflight:
+            t_rb = time.perf_counter()
             jax.block_until_ready(done)
+            rb_s.append(time.perf_counter() - t_rb)
             lat_samples.append(time.perf_counter() - t_sub)
         elapsed = time.perf_counter() - start
 
@@ -692,10 +726,18 @@ def main() -> int:
         # Effective per-frame latency through a depth-`depth` pipeline.
         p50 = float(np.percentile(lat_samples, 50)) * 1e3
         p99 = float(np.percentile(lat_samples, 99)) * 1e3
+        host_stages = {
+            stage: round(float(np.percentile(samples, 50)) * 1e3, 3)
+            for stage, samples in (
+                ("device_put", put_s), ("launch", launch_s),
+                ("readback", rb_s),
+            ) if samples
+        }
         log(f"[b={b} d={depth}] {frames} frames in {elapsed:.2f}s = "
             f"{fps:.1f} FPS ({streams:.1f} x 1080p30 streams); "
-            f"batch-latency p50={p50:.1f}ms p99={p99:.1f}ms")
-        return streams, p50, p99
+            f"batch-latency p50={p50:.1f}ms p99={p99:.1f}ms "
+            f"host stages {host_stages}")
+        return streams, p50, p99, host_stages
 
     def measure_best(b: int, depth: int, seconds: float):
         """Best-of---repeats windows: the axon tunnel occasionally
@@ -722,14 +764,15 @@ def main() -> int:
         results = [(b, d, *measure_best(b, d, per)) for b, d in points]
         ok = [r for r in results if r[4] <= args.p99_target_ms]
         best = max(ok or results, key=lambda r: r[2])
-        b_, d_, streams, p50, p99 = best
+        b_, d_, streams, p50, p99, host_stages = best
         extra["p99_target_ms"] = args.p99_target_ms
         extra["sla_met"] = bool(ok)
         log(f"sweep winner: batch={b_} depth={d_} ({streams:.1f} streams, "
             f"p99={p99:.0f}ms, target {args.p99_target_ms:.0f}ms, "
             f"sla_met={bool(ok)})")
     else:
-        streams, p50, p99 = measure_best(args.batch, args.depth, args.seconds)
+        streams, p50, p99, host_stages = measure_best(
+            args.batch, args.depth, args.seconds)
         b_, d_ = args.batch, args.depth
 
     if args.config == "action":
@@ -753,6 +796,7 @@ def main() -> int:
         "depth": d_,
         "p50_ms": round(p50, 1),
         "p99_ms": round(p99, 1),
+        "host_stage_p50_ms": host_stages,
         **extra,
     }))
     return 0

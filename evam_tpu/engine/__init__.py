@@ -1,5 +1,6 @@
 from evam_tpu.engine.batcher import BatchEngine, EngineStats
 from evam_tpu.engine.hub import EngineHub
+from evam_tpu.engine.ringbuf import STAGES, SlotRing
 from evam_tpu.engine.steps import (
     build_detect_step,
     build_classify_step,
@@ -13,6 +14,8 @@ __all__ = [
     "BatchEngine",
     "EngineStats",
     "EngineHub",
+    "SlotRing",
+    "STAGES",
     "build_detect_step",
     "build_classify_step",
     "build_action_encode_step",

@@ -7,26 +7,43 @@ pipeline.json:26-32), evam_tpu runs ONE BatchEngine per model
 instance and multiplexes every active stream into it (BASELINE.json
 north_star). Three cooperating threads per engine:
 
-  submit() ──queue──► dispatcher ──in-flight──► completion ──► futures
+  submit() ──slot──► dispatcher ──in-flight──► completion ──► futures
 
-* the **dispatcher** collects items up to a batch deadline
-  (latency/occupancy tension, SURVEY.md §7 "hard parts"), pads to a
-  bucketed batch size (bounded compile count), places the batch on
-  the mesh (data-axis sharded) and launches the jitted step —
-  WITHOUT waiting for the result;
+* **submit()** (stream threads) writes each item's arrays straight
+  into its reserved row of a pre-allocated staging slot
+  (engine/ringbuf.py) — the one host copy, parallelized across
+  submitters instead of serialized on the dispatcher;
+* the **dispatcher** seals a slot at the batch deadline
+  (latency/occupancy tension, SURVEY.md §7 "hard parts"): picks the
+  bucket (bounded compile count), zeroes only the dirty pad tail
+  (no stack, no concat, no allocation), places the block view on the
+  mesh (data-axis sharded) and launches the jitted step — WITHOUT
+  waiting for the result;
 * the **completion** thread performs the single device→host readback
-  per batch and resolves per-item futures. Keeping dispatch and
-  readback on separate threads double-buffers the device: batch N+1
-  is enqueued while batch N computes (the decode-ahead/infer overlap
-  the reference gets from GStreamer element threads, SURVEY.md §2d-5);
+  per batch, resolves per-item futures, and returns the slot to the
+  ring. Keeping dispatch and readback on separate threads
+  double-buffers the device: batch N+1 is enqueued while batch N
+  computes (the decode-ahead/infer overlap the reference gets from
+  GStreamer element threads, SURVEY.md §2d-5);
 * an in-flight semaphore bounds device queueing (backpressure, the
   analogue of the reference msgbus ``zmq_recv_hwm``,
-  eii/config.json:37).
+  eii/config.json:37); the staging ring adds a second, host-side
+  bound — a slot is reusable only after its batch's readback.
+
+Every batch carries a **stage clock** (ringbuf.STAGES: submit_wait →
+slot_write → seal → device_put → launch → readback → resolve) into
+``EngineStats`` and the ``evam_engine_stage_seconds`` histogram, so
+the serve bench and /healthz can attribute host overhead instead of
+hiding it inside a throughput number (VERDICT r5 weak #5).
+
+``EVAM_BATCH_ASSEMBLY=legacy`` keeps the old allocate-stack-pad
+dispatch path for A/B (tools/bench_hostpath.py measures the delta).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -37,6 +54,7 @@ import jax
 import numpy as np
 
 from evam_tpu.engine import devlock
+from evam_tpu.engine.ringbuf import STAGES, SealedBatch, SlotRing
 from evam_tpu.obs import get_logger, metrics
 from evam_tpu.parallel.mesh import MeshPlan
 
@@ -71,10 +89,28 @@ class EngineStats:
     batches: int = 0
     items: int = 0
     occupancy_sum: float = 0.0
+    #: cumulative per-stage host clock (seconds), keyed by
+    #: ringbuf.STAGES — submit_wait/slot_write/seal come from the
+    #: dispatcher, device_put/launch from the launch span,
+    #: readback/resolve from the completion thread. Single writer per
+    #: key, so plain dict updates are safe.
+    stage_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def mean_occupancy(self) -> float:
         return self.occupancy_sum / self.batches if self.batches else 0.0
+
+    def add_stage(self, stage: str, dt: float) -> None:
+        self.stage_seconds[stage] = self.stage_seconds.get(stage, 0.0) + dt
+
+    def stage_ms_per_batch(self) -> dict[str, float]:
+        """Mean per-batch host cost of each pipeline stage (ms)."""
+        if not self.batches:
+            return {}
+        return {
+            s: round(1e3 * self.stage_seconds.get(s, 0.0) / self.batches, 3)
+            for s in STAGES if s in self.stage_seconds
+        }
 
 
 class BatchEngine:
@@ -96,6 +132,9 @@ class BatchEngine:
         max_in_flight: int = 3,
         input_names: tuple[str, ...] = ("frames",),
         stall_timeout_s: float = 120.0,
+        assembly: str | None = None,
+        staging_depth: int | None = None,
+        donate_inputs: bool | None = None,
     ):
         self.name = name
         self.plan = plan
@@ -103,6 +142,15 @@ class BatchEngine:
         self.deadline_s = deadline_ms / 1000.0
         self.input_names = input_names
         self.stats = EngineStats()
+        #: host batch assembly: "slot" (pre-allocated staging ring,
+        #: default) or "legacy" (per-batch stack+concat) — kept for
+        #: A/B via EVAM_BATCH_ASSEMBLY (tools/bench_hostpath.py)
+        self.assembly = (assembly
+                         or os.environ.get("EVAM_BATCH_ASSEMBLY", "slot"))
+        if self.assembly not in ("slot", "legacy"):
+            raise ValueError(
+                f"EVAM_BATCH_ASSEMBLY must be 'slot' or 'legacy', "
+                f"got {self.assembly!r}")
         #: watchdog bound on one batch's device round-trip; a wedged
         #: backend (e.g. a dead TPU tunnel) blocks the dispatcher in
         #: C++ forever — the watchdog can't unblock it, but it CAN
@@ -130,6 +178,28 @@ class BatchEngine:
             b *= 2
         self.buckets.append(top)
 
+        #: staging ring: blocks sized to the LARGEST bucket so a
+        #: sealed batch is always a contiguous [:bucket] prefix view;
+        #: max_in_flight + 1 deep (one slot assembling while
+        #: max_in_flight batches ride the device) so the ring never
+        #: shrinks the device pipeline, while bounding host memory at
+        #: depth × top-bucket batches. EVAM_STAGING_DEPTH overrides.
+        depth = staging_depth or int(
+            os.environ.get("EVAM_STAGING_DEPTH", "0")) or (max_in_flight + 1)
+        self._ring = (SlotRing(capacity=self.buckets[-1], depth=depth)
+                      if self.assembly == "slot" else None)
+
+        #: donate input device buffers into the jitted step so XLA can
+        #: alias them for outputs — a real HBM/bandwidth win on TPU,
+        #: a no-op warning on CPU, hence the backend gate. Step
+        #: signatures are donation-friendly by construction: inputs
+        #: are positional after params and never aliased with them
+        #: (engine/steps.py design constraints).
+        if donate_inputs is None:
+            donate_inputs = jax.default_backend() == "tpu"
+        donate = (tuple(range(1, 1 + len(input_names)))
+                  if donate_inputs else ())
+
         if plan is not None:
             self._params = jax.device_put(params, plan.replicated())
             self._jit_step = jax.jit(
@@ -138,10 +208,11 @@ class BatchEngine:
                     plan.replicated(),
                     *([plan.batch_sharding()] * len(input_names)),
                 ),
+                donate_argnums=donate,
             )
         else:
             self._params = params
-            self._jit_step = jax.jit(step_fn)
+            self._jit_step = jax.jit(step_fn, donate_argnums=donate)
 
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._done: queue.Queue[tuple | None] = queue.Queue()
@@ -152,7 +223,9 @@ class BatchEngine:
         self._in_flight = threading.Semaphore(max_in_flight)
         self._stop = threading.Event()
         self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name=f"engine-{name}-dispatch", daemon=True
+            target=(self._dispatch_loop_slot if self._ring is not None
+                    else self._dispatch_loop_legacy),
+            name=f"engine-{name}-dispatch", daemon=True,
         )
         self._completer = threading.Thread(
             target=self._completion_loop, name=f"engine-{name}-complete", daemon=True
@@ -168,7 +241,12 @@ class BatchEngine:
     # ------------------------------------------------------------- API
 
     def submit(self, **inputs: np.ndarray) -> Future:
-        """Enqueue one item (no batch dim); resolves to its packed row(s)."""
+        """Enqueue one item (no batch dim); resolves to its packed row(s).
+
+        On the slot path this call COPIES the item's arrays into the
+        staging block on the calling thread (ringbuf.write) — the
+        dispatcher never re-stacks them — and blocks only when every
+        staging slot is in flight (host-side backpressure)."""
         if self._stop.is_set():
             raise RuntimeError(f"engine {self.name} is stopped")
         if self.stalled.is_set():
@@ -183,7 +261,14 @@ class BatchEngine:
                 f"engine {self.name} expects inputs {self.input_names}, got {tuple(inputs)}"
             )
         fut: Future = Future()
-        self._queue.put(_WorkItem(inputs, fut, time.perf_counter()))
+        item = _WorkItem(inputs, fut, time.perf_counter())
+        if self._ring is not None:
+            try:
+                self._ring.write(inputs, item)
+            except RuntimeError:
+                raise RuntimeError(f"engine {self.name} is stopped") from None
+        else:
+            self._queue.put(item)
         return fut
 
     def warmup(self) -> None:
@@ -225,17 +310,23 @@ class BatchEngine:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._ring is not None:
+            self._ring.close()
         self._queue.put(None)
         self._dispatcher.join(timeout=10)
         self._done.put(None)
         self._completer.join(timeout=10)
+        exc = RuntimeError("engine stopped")
+        if self._ring is not None:
+            for item in self._ring.drain_items():
+                _safe_set_exception(item.future, exc)
         while True:
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
             if item is not None:
-                item.future.set_exception(RuntimeError("engine stopped"))
+                _safe_set_exception(item.future, exc)
 
     # -------------------------------------------------------- internals
 
@@ -257,20 +348,85 @@ class BatchEngine:
                 return b
         return self.buckets[-1]
 
-    def _run(self, batch: dict[str, np.ndarray]):
+    def _run(self, batch: dict[str, np.ndarray],
+             clock: dict[str, float] | None = None):
         # devlock: with EVAM_SERIALIZE_COMPILE=1 this launch (and any
         # compile it triggers) cannot overlap another engine thread's
         # device RPC — the wedge-proof measurement mode
         with devlock.device_call(f"{self.name}:launch"):
+            t0 = time.perf_counter()
             arrays = []
             for name in self.input_names:
                 a = batch[name]
                 if self.plan is not None:
                     a = jax.device_put(a, self.plan.batch_sharding())
                 arrays.append(a)
-            return self._jit_step(self._params, *arrays)
+            t1 = time.perf_counter()
+            out = self._jit_step(self._params, *arrays)
+            if clock is not None:
+                clock["device_put"] = t1 - t0
+                clock["launch"] = time.perf_counter() - t1
+            return out
 
-    def _dispatch_loop(self) -> None:
+    def _record_batch(self, n: int, b: int,
+                      clock: dict[str, float]) -> None:
+        self.stats.batches += 1
+        self.stats.items += n
+        self.stats.occupancy_sum += n / b
+        metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
+        depth = (self._ring.pending_items() if self._ring is not None
+                 else self._queue.qsize())
+        metrics.set("evam_engine_queue_depth", depth, {"engine": self.name})
+        for stage, dt in clock.items():
+            self.stats.add_stage(stage, dt)
+            metrics.observe(
+                "evam_engine_stage_seconds", dt,
+                {"engine": self.name, "stage": stage})
+
+    # ------------------------------------------------- slot dispatch
+
+    def _dispatch_loop_slot(self) -> None:
+        """Seal staged slots at the batch deadline and launch them —
+        no stack, no pad concat, no per-batch allocation."""
+        while True:
+            sealed = self._ring.next_batch(self.deadline_s, self._bucket)
+            if sealed is None:
+                if self._stop.is_set():
+                    break
+                continue
+            if self._stop.is_set():
+                exc = RuntimeError("engine stopped")
+                for it in sealed.items:
+                    _safe_set_exception(it.future, exc)
+                self._ring.release(sealed)
+                continue  # drain whatever else is staged, then exit
+
+            self._in_flight.acquire()
+            t0 = time.perf_counter()
+            with self._exec_lock:
+                bid = self._next_batch_id
+                self._next_batch_id += 1
+                self._outstanding[bid] = (t0, sealed.items)
+            try:
+                out = self._run(sealed.arrays, clock=sealed.clock)
+            except Exception as exc:  # noqa: BLE001 — surface to every caller
+                self._in_flight.release()
+                with self._exec_lock:
+                    self._outstanding.pop(bid, None)
+                for it in sealed.items:
+                    _safe_set_exception(it.future, exc)
+                self._ring.release(sealed)
+                log.exception("engine %s step failed", self.name)
+                continue
+            self._done.put((out, sealed.items, t0, bid, sealed))
+            self._record_batch(sealed.n, sealed.bucket, sealed.clock)
+
+    # ----------------------------------------------- legacy dispatch
+
+    def _dispatch_loop_legacy(self) -> None:
+        """Pre-ring path (EVAM_BATCH_ASSEMBLY=legacy): per-batch
+        stack + zero-pad concat on the dispatcher thread. Kept for
+        A/B measurement — tools/bench_hostpath.py."""
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.1)
@@ -295,6 +451,10 @@ class BatchEngine:
 
             n = len(items)
             b = self._bucket(n)
+            clock: dict[str, float] = {
+                "submit_wait": time.perf_counter() - items[0].t_submit,
+            }
+            t_asm = time.perf_counter()
             batch: dict[str, np.ndarray] = {}
             for name in self.input_names:
                 rows = [it.inputs[name] for it in items]
@@ -303,6 +463,7 @@ class BatchEngine:
                     pad = np.zeros((b - n,) + stacked.shape[1:], stacked.dtype)
                     stacked = np.concatenate([stacked, pad])
                 batch[name] = stacked
+            clock["slot_write"] = time.perf_counter() - t_asm
 
             self._in_flight.acquire()
             t0 = time.perf_counter()
@@ -311,7 +472,7 @@ class BatchEngine:
                 self._next_batch_id += 1
                 self._outstanding[bid] = (t0, items)
             try:
-                out = self._run(batch)
+                out = self._run(batch, clock=clock)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
                 self._in_flight.release()
                 with self._exec_lock:
@@ -320,19 +481,18 @@ class BatchEngine:
                     _safe_set_exception(it.future, exc)
                 log.exception("engine %s step failed", self.name)
                 continue
-            self._done.put((out, items, t0, bid))
-            self.stats.batches += 1
-            self.stats.items += n
-            self.stats.occupancy_sum += n / b
-            metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
-            metrics.set("evam_engine_queue_depth", self._queue.qsize(), {"engine": self.name})
+            self._done.put((out, items, t0, bid, None))
+            self._record_batch(n, b, clock)
+
+    # ------------------------------------------------------ completion
 
     def _completion_loop(self) -> None:
         while True:
             entry = self._done.get()
             if entry is None:
                 break
-            out, items, t0, bid = entry
+            out, items, t0, bid, sealed = entry
+            t_rb = time.perf_counter()
             try:
                 with devlock.device_call(f"{self.name}:readback"):
                     host = np.asarray(out)  # single readback per batch
@@ -340,11 +500,17 @@ class BatchEngine:
                 for it in items:
                     _safe_set_exception(it.future, exc)
                 self._in_flight.release()
+                if sealed is not None:
+                    self._ring.release(sealed)
                 continue
             finally:
                 with self._exec_lock:
                     self._outstanding.pop(bid, None)
             self._in_flight.release()
+            if sealed is not None:
+                # the staging block is free the moment the readback
+                # materialized the output on host
+                self._ring.release(sealed)
             if self.stalled.is_set():
                 # the "wedged" call was merely slow (e.g. a mid-traffic
                 # multichip compile) and has now completed — recover
@@ -356,11 +522,20 @@ class BatchEngine:
                 )
             now = time.perf_counter()
             metrics.observe("evam_step_seconds", now - t0, {"engine": self.name})
+            readback_s = now - t_rb
+            t_res = time.perf_counter()
             for i, it in enumerate(items):
                 metrics.observe(
                     "evam_item_latency_seconds", now - it.t_submit, {"engine": self.name}
                 )
                 _safe_set_result(it.future, host[i])
+            resolve_s = time.perf_counter() - t_res
+            self.stats.add_stage("readback", readback_s)
+            self.stats.add_stage("resolve", resolve_s)
+            metrics.observe("evam_engine_stage_seconds", readback_s,
+                            {"engine": self.name, "stage": "readback"})
+            metrics.observe("evam_engine_stage_seconds", resolve_s,
+                            {"engine": self.name, "stage": "resolve"})
 
     def _watchdog_loop(self) -> None:
         """Fail futures stranded behind a wedged device call and flag
@@ -390,7 +565,10 @@ class BatchEngine:
             )
             for it in stuck:
                 _safe_set_exception(it.future, exc)
-            # strand nothing in the queue either
+            # strand nothing in the staging ring or queue either
+            if self._ring is not None:
+                for it in self._ring.drain_items():
+                    _safe_set_exception(it.future, exc)
             while True:
                 try:
                     queued = self._queue.get_nowait()

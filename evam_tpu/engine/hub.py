@@ -193,9 +193,32 @@ class EngineHub:
                     "items": e.stats.items,
                     "mean_occupancy": e.stats.mean_occupancy,
                     "warmed": e.warmed.is_set(),
+                    "assembly": e.assembly,
+                    # per-batch host clock means (ringbuf.STAGES order)
+                    "stage_ms": e.stats.stage_ms_per_batch(),
                 }
                 for k, e in self._engines.items()
             }
+
+    def stage_summary(self) -> dict[str, float]:
+        """Batch-weighted mean per-batch host-stage cost across ALL
+        engines (ms) — the /healthz attribution block: where a
+        batch's wall time goes (slot-write vs device_put vs launch vs
+        readback) without scraping /metrics quantiles. Keys are fixed
+        (ringbuf.STAGES) from boot so the health payload keeps a
+        stable shape; per-engine detail lives on /engines."""
+        from evam_tpu.engine.ringbuf import STAGES
+
+        with self._lock:
+            engines = list(self._engines.values())
+        batches = sum(e.stats.batches for e in engines)
+        return {
+            s: (round(
+                1e3 * sum(e.stats.stage_seconds.get(s, 0.0)
+                          for e in engines) / batches, 3)
+                if batches else 0.0)
+            for s in STAGES
+        }
 
     def readiness(self) -> dict[str, int]:
         """Engine warm state for /healthz (serve-time preload,

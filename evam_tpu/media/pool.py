@@ -113,9 +113,14 @@ class PooledStream:
         if self.drop_when_full:
             dropped = drop_oldest_put(self.queue, ev)
             if dropped:
+                # every pool drop is consumer-side by construction
+                # (lossless mode parks instead of dropping): the
+                # downstream runner/engine is behind — same stage
+                # attribution as DemuxStream.frames_dropped_downstream
                 self.frames_dropped += dropped
                 metrics.inc("evam_frames_dropped", dropped,
-                            labels={"stream": self.stream_id})
+                            labels={"stream": self.stream_id,
+                                    "stage": "downstream"})
         else:
             # lossless: park the frame; the pool retries the put on
             # the stream's next turn (never blocks a shared worker)
@@ -154,6 +159,11 @@ class DecodePool:
         self.restart_backoff_s = restart_backoff_s
         #: (due_time, turn_seq, stream, restarts_left)
         self._heap: list = []
+        #: cumulative counters of terminated streams (same fold-on-
+        #: retire pattern as RtspDemux: long-lived servers churn
+        #: streams, dead objects must not accumulate)
+        self._retired_decoded = 0
+        self._retired_dropped = 0
         self._turn = itertools.count()
         self._cv = threading.Condition()
         self._stop = False
@@ -197,15 +207,38 @@ class DecodePool:
         for ps in pending:
             ps.close()
             ps._finish("pool stopped")
+            self._fold(ps)
         for t in self._threads:
             t.join(timeout=10)
 
-    def stats(self) -> dict:
-        """Worker/stream counts for /healthz (same shape family as
-        ``RtspDemux.stats``)."""
+    def _fold(self, ps: PooledStream) -> None:
+        """Fold a terminated stream's counters into the cumulative
+        totals (called exactly once per stream: terminal _service
+        return, stop-race cleanup, or pool stop)."""
         with self._cv:
-            streams = len(self._heap)
-        return {"workers": len(self._threads), "queued_streams": streams}
+            self._retired_decoded += ps.frames_decoded
+            self._retired_dropped += ps.frames_dropped
+
+    def stats(self) -> dict:
+        """Worker/stream counts + cumulative frame counters for
+        /healthz (same shape family as ``RtspDemux.stats``). Pool
+        drops are all consumer-side (``dropped_downstream`` ==
+        ``dropped``): lossless streams park instead of dropping, and
+        drop-when-full only engages when the runner/engine lags —
+        decode-bound loss can't happen inside the pool itself."""
+        with self._cv:
+            live = [e[2] for e in self._heap]
+            decoded = self._retired_decoded
+            dropped = self._retired_dropped
+        decoded += sum(s.frames_decoded for s in live)
+        dropped += sum(s.frames_dropped for s in live)
+        return {
+            "workers": len(self._threads),
+            "queued_streams": len(live),
+            "decoded": decoded,
+            "dropped": dropped,
+            "dropped_downstream": dropped,
+        }
 
     # -------------------------------------------------------- workers
 
@@ -233,6 +266,9 @@ class DecodePool:
                         continue
                 ps.close()
                 ps._finish("pool stopped")
+                self._fold(ps)
+            else:
+                self._fold(ps)  # terminal: stream left the pool
 
     def _service(self, ps: PooledStream, restarts_left: int):
         """Decode one frame of ``ps``; return its next heap entry or

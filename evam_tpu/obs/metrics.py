@@ -22,6 +22,14 @@ def _label_str(labels: dict[str, str] | None) -> str:
     return "{" + inner + "}"
 
 
+def _parse_labels(label_str: str) -> dict[str, str]:
+    """Inverse of ``_label_str`` for the values it emits (no escaped
+    quotes in our label values)."""
+    import re
+
+    return dict(re.findall(r'(\w+)="([^"]*)"', label_str))
+
+
 @dataclass
 class _Histogram:
     """Fixed-reservoir histogram good enough for p50/p99 reporting."""
@@ -110,6 +118,27 @@ class MetricsRegistry:
                 for (n, labels), hist in self._hists.items()
                 if n == name
             }
+
+    def quantiles_grouped(self, name: str, q: float,
+                          group_by: str) -> dict[str, float]:
+        """One histogram's series folded onto a SINGLE label key:
+        {label_value: max quantile across the other labels}. The
+        engine stage clock (evam_engine_stage_seconds{engine,stage})
+        reports per stage this way — the slowest engine's stage cost
+        is the one that bounds the serving path."""
+        out: dict[str, float] = {}
+        with self._lock:
+            series = [
+                (labels, hist.quantile(q))
+                for (n, labels), hist in self._hists.items()
+                if n == name
+            ]
+        for label_str, value in series:
+            key = _parse_labels(label_str).get(group_by)
+            if key is None:
+                continue
+            out[key] = max(out.get(key, 0.0), value)
+        return out
 
     def render(self) -> str:
         """Prometheus text exposition format."""

@@ -75,6 +75,12 @@ def _wire_frame(
     stream counts. native.resize_bgr_to_i420 owns the
     native-vs-cv2 policy and fallback.
 
+    The returned array is copied into the engine's staging slot by
+    ``BatchEngine.submit`` ON THIS (the stream's) thread — together
+    the wire encode and the slot write are the stream's entire
+    per-frame host cost; the dispatcher never touches the pixels
+    again (engine/ringbuf.py).
+
     ``wire_format="seed"`` (EngineHub.device_synth, bench.py --config
     serve): the engine synthesizes pixels on-chip, so the stage
     submits only a distinct uint32 per frame."""

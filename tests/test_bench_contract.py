@@ -46,6 +46,10 @@ def test_bench_healthy_cpu_run_emits_contract_line():
     assert data["metric"] == "audio_streams_per_chip"
     assert data["value"] > 0
     assert {"batch", "depth", "p50_ms", "p99_ms"} <= set(data)
+    # host-latency attribution rides the contract line (launch
+    # dispatch + readback wait; device_put appears under --ingest
+    # host) without changing the metric's definition
+    assert {"launch", "readback"} <= set(data["host_stage_p50_ms"])
 
 
 def test_bench_serialize_compile_serve_emits_contract_line():
@@ -65,6 +69,27 @@ def test_bench_serialize_compile_serve_emits_contract_line():
     assert data["metric"] == "serve_streams_30fps_per_chip"
     assert data["errors"] == 0
     assert data["dead_streams"] == 0
+    # the serve line attributes host latency by engine stage
+    # (ringbuf.STAGES) next to the throughput number
+    assert {"slot_write", "launch", "readback"} \
+        <= set(data["host_stage_p50_ms"])
+
+
+def test_bench_hostpath_slot_not_slower_than_legacy():
+    """The CI-adjacent host-assembly assertion: slot-ring staging must
+    never be slower than the legacy stack+concat path at the serving
+    bucket (tools/bench_hostpath.py exits nonzero if it is; PROFILE.md
+    'Host batching cost' records the measured speedup)."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_hostpath.py"),
+         "--reps", "10"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    data = json.loads(r.stdout.strip().splitlines()[-1])
+    assert data["metric"] == "host_assembly_speedup"
+    assert data["ok"] is True
+    assert data["value"] >= 1.0
 
 
 def test_bench_unreachable_device_still_emits_contract_line():

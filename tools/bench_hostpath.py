@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Host batch-assembly microbench: legacy stack+concat vs slot ring.
+
+CPU-only, runs in seconds, no JAX involved — this isolates exactly the
+host work `BatchEngine`'s dispatcher used to do per batch (allocate +
+``np.stack(rows)`` + zero-pad ``np.concatenate``) against the slot
+path (`engine/ringbuf.SlotRing`: pre-allocated staging blocks, row
+writes, zeroed-tail seal). The legacy engine path stays selectable at
+runtime via ``EVAM_BATCH_ASSEMBLY=legacy`` for end-to-end A/B; this
+tool is the cheap, deterministic comparison the CI-adjacent path runs.
+
+Exit status is the assertion: nonzero when slot-mode assembly is
+SLOWER than legacy for the measured shape (it must never be — the
+slot path exists to make the hot path cheaper). The headline number
+to record in PROFILE.md is ``speedup`` at the largest bucket
+(acceptance: ≥ 1.5× there; measured ~4.7× full / ~1.9× padded on the
+1-vCPU dev box — fresh-allocation page faults dominate legacy cost).
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from evam_tpu.engine.ringbuf import SlotRing  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_legacy(rows: list[np.ndarray], bucket: int, reps: int) -> float:
+    """Median seconds per batch for the stack+concat path (the exact
+    shape of the old ``BatchEngine._dispatch_loop`` assembly)."""
+    n = len(rows)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        stacked = np.stack(rows)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + stacked.shape[1:],
+                           stacked.dtype)
+            stacked = np.concatenate([stacked, pad])
+        times.append(time.perf_counter() - t0)
+        del stacked
+    return float(np.median(times))
+
+
+def bench_slot(rows: list[np.ndarray], bucket: int, reps: int) -> float:
+    """Median seconds per batch through the REAL SlotRing (reserve +
+    row write + seal + release), depth 2 so slots actually recycle."""
+    ring = SlotRing(capacity=bucket, depth=2)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i, r in enumerate(rows):
+            ring.write({"frames": r}, i)
+        sealed = ring.next_batch(0.0, lambda n: bucket)
+        times.append(time.perf_counter() - t0)
+        assert sealed is not None and sealed.n == len(rows)
+        ring.release(sealed)
+    return float(np.median(times))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--bucket", type=int, default=128,
+                   help="batch bucket (block capacity); default is the "
+                        "largest bucket at the hub's serving default "
+                        "(EngineHub max_batch=128 — the shape whose "
+                        "stack+concat cost the slot path removes)")
+    p.add_argument("--rows", type=int, default=0,
+                   help="items in the batch (0 = full bucket; below "
+                        "bucket exercises the zeroed-pad tail)")
+    p.add_argument("--height", type=int, default=648,
+                   help="wire row height (default: 432x768 ingest in "
+                        "I420 wire = 648x768 uint8)")
+    p.add_argument("--width", type=int, default=768)
+    p.add_argument("--reps", type=int, default=30)
+    p.add_argument("--min-speedup", type=float, default=1.0,
+                   help="fail below this slot-vs-legacy ratio (the "
+                        "CI-adjacent assertion: never slower)")
+    args = p.parse_args()
+
+    n = args.rows or args.bucket
+    if n > args.bucket:
+        p.error("--rows must be <= --bucket")
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(0, 255, (args.height, args.width), np.uint8)
+            for _ in range(n)]
+    row_mb = rows[0].nbytes / 1e6
+    log(f"assembling {n} rows of {args.height}x{args.width} uint8 "
+        f"({row_mb:.2f} MB each) into bucket {args.bucket}, "
+        f"{args.reps} reps")
+
+    # interleave the two modes' warmups so neither benefits from a
+    # warmer page cache
+    bench_legacy(rows, args.bucket, 3)
+    bench_slot(rows, args.bucket, 3)
+    legacy_s = bench_legacy(rows, args.bucket, args.reps)
+    slot_s = bench_slot(rows, args.bucket, args.reps)
+    speedup = legacy_s / slot_s if slot_s > 0 else float("inf")
+    log(f"legacy {legacy_s * 1e3:.2f} ms/batch, "
+        f"slot {slot_s * 1e3:.2f} ms/batch → {speedup:.2f}x")
+
+    print(json.dumps({
+        "metric": "host_assembly_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "legacy_ms": round(legacy_s * 1e3, 3),
+        "slot_ms": round(slot_s * 1e3, 3),
+        "bucket": args.bucket,
+        "rows": n,
+        "row_shape": [args.height, args.width],
+        "ok": speedup >= args.min_speedup,
+    }))
+    if speedup < args.min_speedup:
+        log(f"FAIL: slot assembly is slower than legacy "
+            f"({speedup:.2f}x < {args.min_speedup:.2f}x)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
