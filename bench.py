@@ -382,10 +382,19 @@ def run_serve_bench(args) -> dict:
         states = [inst.state.value for inst in insts]
         dead = sum(1 for s in states if s not in ("RUNNING", "QUEUED"))
         # snapshot before stop(): hub.stop() drops the engine registry
+        eng_stats = reg.hub.stats()
         occupancy = {
             k: round(v["items"] / max(1, v["batches"]), 1)
-            for k, v in reg.hub.stats().items()
+            for k, v in eng_stats.items()
         }
+        # engine supervision outcome (engine/supervisor.py): a wedge
+        # mid-window shows up as restarts>0 with state back to
+        # running — or as a degraded engine, which the driver must
+        # not mistake for a healthy low-throughput run
+        engine_restarts = sum(
+            v.get("restarts", 0) for v in eng_stats.values())
+        engine_states = {
+            k: v.get("state", "running") for k, v in eng_stats.items()}
         demux_stats = (reg.rtsp_demux.stats()
                        if reg.rtsp_demux is not None else None)
     finally:
@@ -422,6 +431,8 @@ def run_serve_bench(args) -> dict:
         "host_stage_p50_ms": best["host_stage_p50_ms"],
         "errors": errors,
         "dead_streams": dead,
+        "engine_restarts": engine_restarts,
+        "engine_states": engine_states,
         **({"demux": demux_stats} if demux_stats else {}),
     }
 

@@ -51,6 +51,21 @@ class TPUSettings(BaseModel):
     #: engine stall watchdog: one batch's device round-trip bound in
     #: seconds (0 disables); raise for very large models/compiles
     stall_timeout_s: float = 120.0
+    #: engine supervision (engine/supervisor.py): quarantine a wedged
+    #: engine and rebuild it in place instead of serving 503 until a
+    #: process restart
+    supervise: bool = True
+    #: restart budget: at most this many rebuilds per engine within
+    #: restart_window_s; exhausting it is terminal `degraded`
+    max_restarts: int = 3
+    restart_window_s: float = 300.0
+    #: base of the exponential backoff between quarantine and rebuild
+    restart_backoff_s: float = 0.5
+    #: stall-watchdog multiplier for a bucket's FIRST batch (its
+    #: round-trip contains trace + XLA compile); without it every
+    #: cold start — including a supervisor rebuild's fresh jit —
+    #: reads as a wedge
+    first_batch_grace: float = 10.0
 
 
 class Settings(BaseModel):
@@ -88,6 +103,10 @@ class Settings(BaseModel):
     #: blocking reader via cv2/FFmpeg (default; required for
     #: non-RFC-2435 camera codecs until RFC 6184 lands).
     rtsp_demux_workers: int = 0
+    #: shutdown drain: per-instance join budget in seconds; stragglers
+    #: past it are logged and counted (evam_shutdown_leaked_streams),
+    #: never waited on indefinitely
+    drain_timeout_s: float = 5.0
     tpu: TPUSettings = Field(default_factory=TPUSettings)
 
     @classmethod
@@ -116,6 +135,7 @@ class Settings(BaseModel):
             "EVAM_PRELOAD": ("preload", str),
             "EVAM_DECODE_POOL_WORKERS": ("decode_pool_workers", int),
             "EVAM_RTSP_DEMUX_WORKERS": ("rtsp_demux_workers", int),
+            "EVAM_DRAIN_TIMEOUT_S": ("drain_timeout_s", float),
         }
         for var, (key, conv) in mapping.items():
             if var in env:
@@ -129,6 +149,11 @@ class Settings(BaseModel):
             "EVAM_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
             "EVAM_WARMUP": ("warmup", _parse_bool),
             "EVAM_STALL_TIMEOUT_S": ("stall_timeout_s", float),
+            "EVAM_ENGINE_SUPERVISE": ("supervise", _parse_bool),
+            "EVAM_ENGINE_MAX_RESTARTS": ("max_restarts", int),
+            "EVAM_ENGINE_RESTART_WINDOW_S": ("restart_window_s", float),
+            "EVAM_ENGINE_RESTART_BACKOFF_S": ("restart_backoff_s", float),
+            "EVAM_FIRST_BATCH_GRACE": ("first_batch_grace", float),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

@@ -1,6 +1,7 @@
 from evam_tpu.engine.batcher import BatchEngine, EngineStats
 from evam_tpu.engine.hub import EngineHub
 from evam_tpu.engine.ringbuf import STAGES, SlotRing
+from evam_tpu.engine.supervisor import ENGINE_STATES, SupervisedEngine
 from evam_tpu.engine.steps import (
     build_detect_step,
     build_classify_step,
@@ -16,6 +17,8 @@ __all__ = [
     "EngineHub",
     "SlotRing",
     "STAGES",
+    "SupervisedEngine",
+    "ENGINE_STATES",
     "build_detect_step",
     "build_classify_step",
     "build_action_encode_step",

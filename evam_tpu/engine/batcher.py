@@ -56,6 +56,7 @@ import numpy as np
 from evam_tpu.engine import devlock
 from evam_tpu.engine.ringbuf import STAGES, SealedBatch, SlotRing
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs.faults import from_env as faults_from_env
 from evam_tpu.parallel.mesh import MeshPlan
 
 log = get_logger("engine.batcher")
@@ -135,6 +136,7 @@ class BatchEngine:
         assembly: str | None = None,
         staging_depth: int | None = None,
         donate_inputs: bool | None = None,
+        first_batch_grace: float = 10.0,
     ):
         self.name = name
         self.plan = plan
@@ -158,14 +160,28 @@ class BatchEngine:
         #: degrades and callers stop queueing into a black hole
         #: (SURVEY §5.3 failure detection; 0 disables).
         self.stall_timeout_s = stall_timeout_s
+        #: a bucket's FIRST batch pays jit trace + XLA compile inside
+        #: its device round-trip; counting that against stall_timeout_s
+        #: makes every cold engine — including every supervisor rebuild
+        #: (fresh jit by design) — look wedged and flap until the
+        #: restart budget degrades it. Buckets that have completed a
+        #: batch get the plain budget; unseen buckets get
+        #: stall_timeout_s × first_batch_grace.
+        self.first_batch_grace = first_batch_grace
+        self._buckets_done: set[int] = set()
         #: set when a batch exceeded stall_timeout_s (engine is
         #: considered wedged; submit() fails fast). Cleared if the
         #: wedged call later completes (slow compile, transient hang).
         self.stalled = threading.Event()
         #: every dispatched-but-not-completed batch: id → (t_dispatch,
-        #: items). Covers the device launch, the _done queue wait, AND
-        #: the readback — a wedge anywhere strands nothing.
-        self._outstanding: dict[int, tuple[float, list[_WorkItem]]] = {}
+        #: items, bucket, stall_deadline). Covers the device launch,
+        #: the _done queue wait, AND the readback — a wedge anywhere
+        #: strands nothing. The deadline is FIXED at dispatch time
+        #: (_track_dispatch): a concurrent warmup finishing mid-flight
+        #: must not retroactively shrink an in-flight cold batch's
+        #: compile allowance.
+        self._outstanding: dict[
+            int, tuple[float, list[_WorkItem], int, float]] = {}
         self._next_batch_id = 0
         self._exec_lock = threading.Lock()
 
@@ -222,13 +238,15 @@ class BatchEngine:
         self.warmed = threading.Event()
         self._in_flight = threading.Semaphore(max_in_flight)
         self._stop = threading.Event()
+        dispatch_loop = (self._dispatch_loop_slot if self._ring is not None
+                         else self._dispatch_loop_legacy)
         self._dispatcher = threading.Thread(
-            target=(self._dispatch_loop_slot if self._ring is not None
-                    else self._dispatch_loop_legacy),
+            target=self._thread_guard, args=(dispatch_loop,),
             name=f"engine-{name}-dispatch", daemon=True,
         )
         self._completer = threading.Thread(
-            target=self._completion_loop, name=f"engine-{name}-complete", daemon=True
+            target=self._thread_guard, args=(self._completion_loop,),
+            name=f"engine-{name}-complete", daemon=True,
         )
         self._dispatcher.start()
         self._completer.start()
@@ -237,6 +255,20 @@ class BatchEngine:
                 target=self._watchdog_loop,
                 name=f"engine-{name}-watchdog", daemon=True,
             ).start()
+
+    def _thread_guard(self, loop_fn: Callable) -> None:
+        """Engine worker loops must never escape their thread with a
+        raw traceback: a crashed dispatcher/completer is an ENGINE
+        failure — logged here, detected by the EngineSupervisor via
+        thread liveness, and answered with a quarantine + rebuild."""
+        try:
+            loop_fn()
+        except Exception:  # noqa: BLE001 — terminal thread failure
+            log.exception(
+                "engine %s worker thread %s died; the engine is wedged "
+                "until the supervisor rebuilds it",
+                self.name, threading.current_thread().name,
+            )
 
     # ------------------------------------------------------------- API
 
@@ -283,6 +315,9 @@ class BatchEngine:
             # a warmup must never leave a half-overlapped RPC behind
             with devlock.device_call(f"{self.name}:warmup"):
                 np.asarray(self._run(batch))
+            # warmed bucket = compiled: its batches get the plain
+            # (not first-batch-grace) watchdog budget from here on
+            self._buckets_done.add(b)
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
 
     def warm_async(self, **example: np.ndarray) -> None:
@@ -328,6 +363,67 @@ class BatchEngine:
             if item is not None:
                 _safe_set_exception(item.future, exc)
 
+    def _track_dispatch(self, t0: float, items: list[_WorkItem],
+                        bucket: int) -> int:
+        """Register a dispatched batch with the watchdog; its stall
+        deadline is locked in here. A bucket that has never completed
+        a batch gets stall_timeout_s × first_batch_grace (its
+        round-trip legitimately contains trace + compile). Device
+        execution is ordered, so a batch enqueued behind others can't
+        finish before them: its deadline is additionally floored at
+        the latest outstanding deadline + one plain budget — the tail
+        of a cold engine's first wave inherits the compile wait, but
+        each queued batch extends detection by only stall_timeout_s,
+        so a genuinely wedged engine with a standing backlog is still
+        caught in bounded time."""
+        with self._exec_lock:
+            if bucket not in self._buckets_done:
+                deadline = t0 + self.stall_timeout_s * self.first_batch_grace
+            else:
+                deadline = t0 + self.stall_timeout_s
+            if self._outstanding:
+                queue_ahead = max(
+                    e[3] for e in self._outstanding.values())
+                deadline = max(deadline,
+                               queue_ahead + self.stall_timeout_s)
+            bid = self._next_batch_id
+            self._next_batch_id += 1
+            self._outstanding[bid] = (t0, items, bucket, deadline)
+        return bid
+
+    def abandon(self) -> None:
+        """Quarantine teardown (EngineSupervisor): release every
+        failable caller WITHOUT joining the worker threads — a wedged
+        engine's dispatcher/completer may be blocked in C++ (or an
+        injected wedge's sleep) indefinitely, and the supervisor must
+        not inherit that wait. The threads are daemons; they observe
+        ``_stop``/the closed ring when (if) they ever wake and exit on
+        their own. Idempotent."""
+        self._stop.set()
+        exc = TimeoutError(
+            f"engine {self.name} quarantined: wedged device call; "
+            "the supervisor is rebuilding the engine"
+        )
+        if self._ring is not None:
+            self._ring.close()
+            for item in self._ring.drain_items():
+                _safe_set_exception(item.future, exc)
+        self._queue.put(None)
+        self._done.put(None)
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                _safe_set_exception(item.future, exc)
+        with self._exec_lock:
+            stranded = [it for entry in self._outstanding.values()
+                        for it in entry[1]]
+            self._outstanding.clear()
+        for it in stranded:
+            _safe_set_exception(it.future, exc)
+
     # -------------------------------------------------------- internals
 
     def _example_item(self) -> dict[str, np.ndarray]:
@@ -350,6 +446,13 @@ class BatchEngine:
 
     def _run(self, batch: dict[str, np.ndarray],
              clock: dict[str, float] | None = None):
+        # chaos hook: an injected `wedge` blocks right here — on the
+        # dispatcher thread, inside the engine, exactly where a hung
+        # backend RPC would — so the watchdog/supervisor path is
+        # testable without wedging real hardware (obs/faults.py)
+        inj = faults_from_env()
+        if inj is not None:
+            inj.maybe_wedge(self.name)
         # devlock: with EVAM_SERIALIZE_COMPILE=1 this launch (and any
         # compile it triggers) cannot overlap another engine thread's
         # device RPC — the wedge-proof measurement mode
@@ -403,10 +506,7 @@ class BatchEngine:
 
             self._in_flight.acquire()
             t0 = time.perf_counter()
-            with self._exec_lock:
-                bid = self._next_batch_id
-                self._next_batch_id += 1
-                self._outstanding[bid] = (t0, sealed.items)
+            bid = self._track_dispatch(t0, sealed.items, sealed.bucket)
             try:
                 out = self._run(sealed.arrays, clock=sealed.clock)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
@@ -467,10 +567,7 @@ class BatchEngine:
 
             self._in_flight.acquire()
             t0 = time.perf_counter()
-            with self._exec_lock:
-                bid = self._next_batch_id
-                self._next_batch_id += 1
-                self._outstanding[bid] = (t0, items)
+            bid = self._track_dispatch(t0, items, b)
             try:
                 out = self._run(batch, clock=clock)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
@@ -505,8 +602,12 @@ class BatchEngine:
                 continue
             finally:
                 with self._exec_lock:
-                    self._outstanding.pop(bid, None)
+                    done = self._outstanding.pop(bid, None)
             self._in_flight.release()
+            if done is not None:
+                # bucket compiled + round-tripped: plain watchdog
+                # budget (no first-batch grace) from here on
+                self._buckets_done.add(done[2])
             if sealed is not None:
                 # the staging block is free the moment the readback
                 # materialized the output on host
@@ -540,15 +641,21 @@ class BatchEngine:
     def _watchdog_loop(self) -> None:
         """Fail futures stranded behind a wedged device call and flag
         the engine (the dispatcher/completer threads stay blocked in
-        C++ — only the service-level contract can be saved)."""
-        interval = max(self.stall_timeout_s / 4.0, 1.0)
+        C++ — only the service-level contract can be saved). A
+        bucket's first batch gets stall_timeout_s × first_batch_grace:
+        its round-trip legitimately contains trace + XLA compile, and
+        without the grace every cold start — especially a supervisor
+        rebuild's fresh jit — reads as a wedge."""
+        # floor 0.2 s (was 1.0): supervised tests run sub-second stall
+        # budgets; production timeouts (120 s) still poll every 30 s
+        interval = max(self.stall_timeout_s / 4.0, 0.2)
         while not self._stop.wait(interval):
             now = time.perf_counter()
             with self._exec_lock:
                 slots = list(self._outstanding.values())
             stuck: list[_WorkItem] = []
-            for t0, items in slots:
-                if now - t0 > self.stall_timeout_s:
+            for _t0, items, _b, deadline in slots:
+                if now > deadline:
                     stuck.extend(items)
             if not stuck:
                 continue

@@ -14,6 +14,7 @@ import threading
 
 from evam_tpu.engine import steps as step_builders
 from evam_tpu.engine.batcher import BatchEngine
+from evam_tpu.engine.supervisor import SupervisedEngine
 from evam_tpu.models.registry import LoadedModel, ModelRegistry
 from evam_tpu.obs import get_logger
 from evam_tpu.parallel.mesh import MeshPlan
@@ -42,6 +43,11 @@ class EngineHub:
         warmup: bool = False,
         stall_timeout_s: float = 120.0,
         device_synth: bool = False,
+        supervise: bool = True,
+        max_restarts: int = 3,
+        restart_window_s: float = 300.0,
+        restart_backoff_s: float = 0.5,
+        first_batch_grace: float = 10.0,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -60,7 +66,17 @@ class EngineHub:
         #: (steps.wrap_device_synth) — the serving path minus only the
         #: host→device pixel copy
         self.device_synth = device_synth
-        self._engines: dict[str, BatchEngine] = {}
+        #: engine supervision (engine/supervisor.py): wedged engines
+        #: are quarantined and rebuilt in place, with a restart budget
+        #: (EVAM_ENGINE_MAX_RESTARTS within EVAM_ENGINE_RESTART_WINDOW_S)
+        self.supervise = supervise
+        self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
+        self.restart_backoff_s = restart_backoff_s
+        #: stall-watchdog multiplier for a bucket's first (compiling)
+        #: batch — see BatchEngine._track_dispatch
+        self.first_batch_grace = first_batch_grace
+        self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
         self._synth_hw: dict[str, tuple[int, int] | None] = {}
@@ -100,16 +116,8 @@ class EngineHub:
                 if self.device_synth and wired:
                     step_fn = self._synth_wrap(step_fn, synth_hw, key)
                     self._synth_hw[key] = tuple(synth_hw)
-                self._engines[key] = BatchEngine(
-                    name=key,
-                    step_fn=step_fn,
-                    params=model.params,
-                    plan=self.plan,
-                    max_batch=self.max_batch,
-                    deadline_ms=self.deadline_ms,
-                    input_names=input_names,
-                    stall_timeout_s=self.stall_timeout_s,
-                )
+                self._engines[key] = self._build(
+                    key, step_fn, model.params, input_names)
                 log.info("created engine %s (model %s)", key, model_key)
             elif self.device_synth and synth_hw is not None:
                 self._check_synth_hw(key, synth_hw)
@@ -141,20 +149,43 @@ class EngineHub:
                 if self.device_synth:
                     step_fn = self._synth_wrap(step_fn, synth_hw, key)
                     self._synth_hw[key] = tuple(synth_hw)
-                self._engines[key] = BatchEngine(
-                    name=key,
-                    step_fn=step_fn,
-                    params={"det": det.params, "cls": cls.params},
-                    plan=self.plan,
-                    max_batch=self.max_batch,
-                    deadline_ms=self.deadline_ms,
-                    input_names=("frames",),
-                    stall_timeout_s=self.stall_timeout_s,
-                )
+                self._engines[key] = self._build(
+                    key, step_fn,
+                    {"det": det.params, "cls": cls.params}, ("frames",))
                 log.info("created fused engine %s", key)
             elif self.device_synth and synth_hw is not None:
                 self._check_synth_hw(key, synth_hw)
             return self._engines[key]
+
+    def _build(self, key: str, step_fn, params, input_names):
+        """Construct the engine for ``key`` — as a SupervisedEngine
+        (the stable handle whose live BatchEngine a wedge-triggered
+        rebuild swaps underneath) unless supervision is disabled. The
+        factory closure is the rebuild recipe: a replacement engine
+        gets a fresh ``jax.jit`` wrapper and a fresh SlotRing from the
+        same step function and params."""
+
+        def factory() -> BatchEngine:
+            return BatchEngine(
+                name=key,
+                step_fn=step_fn,
+                params=params,
+                plan=self.plan,
+                max_batch=self.max_batch,
+                deadline_ms=self.deadline_ms,
+                input_names=input_names,
+                stall_timeout_s=self.stall_timeout_s,
+                first_batch_grace=self.first_batch_grace,
+            )
+
+        if not self.supervise:
+            return factory()
+        return SupervisedEngine(
+            key, factory,
+            max_restarts=self.max_restarts,
+            restart_window_s=self.restart_window_s,
+            backoff_s=self.restart_backoff_s,
+        )
 
     def _check_synth_hw(self, key: str, synth_hw) -> None:
         """Device-synth cache hits must agree on the wire resolution —
@@ -196,6 +227,11 @@ class EngineHub:
                     "assembly": e.assembly,
                     # per-batch host clock means (ringbuf.STAGES order)
                     "stage_ms": e.stats.stage_ms_per_batch(),
+                    # supervision lifecycle (engine/supervisor.py);
+                    # unsupervised raw engines report a static running
+                    "state": getattr(e, "state", "running"),
+                    "restarts": getattr(e, "restarts", 0),
+                    "last_stall_ts": getattr(e, "last_stall_ts", None),
                 }
                 for k, e in self._engines.items()
             }
@@ -232,13 +268,26 @@ class EngineHub:
             sum(1 for e in engines if e.warmed.is_set())
             if self.warmup else len(engines)
         )
+        states = [getattr(e, "state", "running") for e in engines]
         return {
             "engines": len(engines),
             "warmed": warmed,
             "warming": len(engines) - warmed,
             # a wedged backend (stall watchdog fired) is a liveness
-            # failure, not a warmup phase — monitoring must see it
-            "stalled": sum(1 for e in engines if e.stalled.is_set()),
+            # failure, not a warmup phase — monitoring must see it.
+            # Supervised engines leave this bucket the moment the
+            # supervisor quarantines them (state flips to restarting/
+            # degraded), so the three counts are disjoint.
+            "stalled": sum(
+                1 for e, s in zip(engines, states)
+                if s == "running" and e.stalled.is_set()
+            ),
+            # supervision (engine/supervisor.py): restarting is a
+            # transient 503 (rebuild in progress), degraded a terminal
+            # one (restart budget exhausted — process restart needed)
+            "restarting": sum(1 for s in states if s == "restarting"),
+            "degraded": sum(1 for s in states if s == "degraded"),
+            "restarts": sum(getattr(e, "restarts", 0) for e in engines),
         }
 
     def stop(self) -> None:

@@ -4,15 +4,42 @@ recovery story is container restart policy). Enabled only via the
 
     EVAM_FAULT_INJECT="drop=0.01,stall=0.001,stall_ms=200,corrupt=0.005"
 
-The runner consults this per frame; injected faults exercise the
-per-frame error isolation, reconnect/backoff, and supervision paths
-under test and soak load.
+Known keys (all probabilities are per-consult, 0..1):
+
+* ``drop``     — probability a video frame is dropped before the chain
+                 (audio events carry frame=None and are never dropped).
+* ``stall``    — probability the stream thread sleeps ``stall_ms``
+                 before processing a frame (simulates decode jitter).
+* ``stall_ms`` — duration of an injected stall (default 100).
+* ``corrupt``  — probability one frame row is overwritten with noise.
+* ``error``    — probability a RuntimeError is raised for the frame
+                 (exercises per-frame error isolation in the runner).
+* ``wedge``    — probability ONE engine batch dispatch blocks inside
+                 the jitted-step call for ``wedge_s`` seconds — the
+                 hung-device-call failure mode (BENCH_r03–r05). Long
+                 enough wedges trip the stall watchdog and drive the
+                 EngineSupervisor's quarantine → rebuild path.
+* ``wedge_s``  — duration of an injected wedge (seconds, default 30).
+* ``wedge_n``  — maximum number of wedge events to inject (default
+                 unlimited); ``wedge=1,wedge_n=1`` wedges exactly the
+                 first dispatched batch — the deterministic chaos-test
+                 shape.
+
+``EVAM_FAULT_SEED`` (integer) seeds the injector's RNG so chaos runs
+are reproducible; unset means a fresh nondeterministic seed per
+process.
+
+The runner consults this per frame and the BatchEngine per batch
+dispatch; injected faults exercise the per-frame error isolation,
+reconnect/backoff, and engine-supervision paths under test and soak
+load.
 """
 
 from __future__ import annotations
 
 import os
 import random
+import threading
 import time
 
 import numpy as np
@@ -23,7 +50,8 @@ from evam_tpu.obs.metrics import metrics
 log = get_logger("obs.faults")
 
 
-_KNOWN_KEYS = {"drop", "stall", "stall_ms", "corrupt", "error"}
+_KNOWN_KEYS = {"drop", "stall", "stall_ms", "corrupt", "error",
+               "wedge", "wedge_s", "wedge_n"}
 
 
 class FaultInjector:
@@ -50,13 +78,21 @@ class FaultInjector:
         self.stall_ms = cfg.get("stall_ms", 100.0)
         self.corrupt_p = cfg.get("corrupt", 0.0)
         self.error_p = cfg.get("error", 0.0)
+        self.wedge_p = cfg.get("wedge", 0.0)
+        self.wedge_s = cfg.get("wedge_s", 30.0)
+        #: remaining wedge events; < 0 means unlimited
+        self._wedge_left = int(cfg.get("wedge_n", -1))
         self._rng = random.Random(seed)
+        # one injector is shared by every stream thread AND every
+        # engine dispatcher (from_env cache) — the wedge countdown
+        # must decrement exactly once per event
+        self._lock = threading.Lock()
 
     @property
     def active(self) -> bool:
         return any(
             p > 0 for p in (self.drop_p, self.stall_p, self.corrupt_p,
-                            self.error_p)
+                            self.error_p, self.wedge_p)
         )
 
     def apply(self, frame: np.ndarray | None):
@@ -88,21 +124,58 @@ class FaultInjector:
             frame[self._rng.randrange(h)] = self._rng.randrange(256)
         return frame
 
+    def maybe_wedge(self, name: str = "") -> None:
+        """Engine-side consult (BatchEngine._run): with probability
+        ``wedge`` block the calling dispatcher thread for ``wedge_s``
+        seconds — indistinguishable, from the watchdog's and
+        supervisor's point of view, from a hung backend RPC."""
+        if not self.wedge_p:
+            return
+        with self._lock:
+            if self._wedge_left == 0:
+                return
+            if self._rng.random() >= self.wedge_p:
+                return
+            if self._wedge_left > 0:
+                self._wedge_left -= 1
+        metrics.inc("evam_faults_injected", labels={"kind": "wedge"})
+        log.error("injected wedge: stalling engine %s for %.1fs "
+                  "(EVAM_FAULT_INJECT)", name or "?", self.wedge_s)
+        time.sleep(self.wedge_s)
 
-_cache: tuple[str, FaultInjector | None] | None = None
+
+_cache: tuple[tuple[str, str], FaultInjector | None] | None = None
 
 
 def from_env() -> FaultInjector | None:
     """Injector for the current EVAM_FAULT_INJECT value, parsed (and
-    its ACTIVE warning logged) once per distinct spec — runners are
-    created per stream and per reconnect attempt."""
+    its ACTIVE warning logged) once per distinct (spec, seed) — runners
+    are created per stream and per reconnect attempt, and the engines
+    consult per batch; they all share one injector so wedge_n and the
+    seeded RNG stream are global."""
     global _cache
     spec = os.environ.get("EVAM_FAULT_INJECT", "")
-    if _cache is not None and _cache[0] == spec:
+    seed_str = os.environ.get("EVAM_FAULT_SEED", "")
+    if _cache is not None and _cache[0] == (spec, seed_str):
         return _cache[1]
-    inj = FaultInjector(spec)
+    seed: int | None = None
+    if seed_str:
+        try:
+            seed = int(seed_str)
+        except ValueError:
+            log.warning("EVAM_FAULT_SEED %r is not an integer; ignoring",
+                        seed_str)
+    inj = FaultInjector(spec, seed=seed)
     result = inj if inj.active else None
     if result is not None:
-        log.warning("fault injection ACTIVE: %s", spec)
-    _cache = (spec, result)
+        log.warning("fault injection ACTIVE: %s%s", spec,
+                    f" (seed={seed})" if seed is not None else "")
+    _cache = ((spec, seed_str), result)
     return result
+
+
+def reset_cache() -> None:
+    """Drop the cached injector (tests: a fresh spec must re-parse and
+    a reused spec must restart its wedge_n countdown)."""
+    global _cache
+    _cache = None

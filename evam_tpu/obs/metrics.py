@@ -100,6 +100,14 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get((name, _label_str(labels)), 0.0)
 
+    def counter_total(self, name: str) -> float:
+        """Sum of one counter across ALL label sets (e.g. total
+        evam_engine_restarts over every engine — the bench contract
+        line and the chaos soak read it this way)."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
     def get_gauge(self, name: str, labels: dict[str, str] | None = None) -> float:
         with self._lock:
             return self._gauges.get((name, _label_str(labels)), 0.0)

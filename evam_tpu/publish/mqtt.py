@@ -20,6 +20,7 @@ import threading
 import time
 
 from evam_tpu.obs import get_logger
+from evam_tpu.obs.metrics import metrics
 
 log = get_logger("publish.mqtt")
 
@@ -180,9 +181,15 @@ class MqttDestination:
                         exc, self._backoff)
             return False
 
+    def _drop(self) -> None:
+        # shared drop accounting across destination kinds (mqtt/zmq/
+        # file): one metric an operator can alert on for ANY sink
+        self._dropped += 1
+        metrics.inc("evam_publish_dropped", labels={"dest": "mqtt"})
+
     def publish(self, meta: dict, frame: bytes | None = None) -> None:
         if not self._ensure():
-            self._dropped += 1
+            self._drop()
             return
         payload = json.dumps(meta, separators=(",", ":")).encode()
         try:
@@ -193,7 +200,7 @@ class MqttDestination:
         except OSError as exc:
             log.warning("mqtt publish failed (%s); reconnecting", exc)
             self._client.disconnect()
-            self._dropped += 1
+            self._drop()
 
     @property
     def dropped(self) -> int:

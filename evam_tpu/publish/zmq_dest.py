@@ -5,13 +5,21 @@ The reference's EII data plane is brokerless ZeroMQ pub/sub carrying
 transports zmq_tcp / zmq_ipc at eii/config.json:17-19, 31-32). The
 frame convention: multipart [topic, meta-json, blob?] so subscribers
 filter server-side by topic prefix.
+
+Failure discipline (same contract as publish/mqtt.py): a publisher
+must never take down its stream. HWM overflow drops the message;
+a broken socket is closed and rebuilt with bounded backoff, dropping
+(and counting, ``evam_publish_dropped{dest="zmq"}``) everything that
+arrives while disconnected.
 """
 
 from __future__ import annotations
 
 import json
+import time
 
 from evam_tpu.obs import get_logger
+from evam_tpu.obs.metrics import metrics
 
 log = get_logger("publish.zmq")
 
@@ -23,33 +31,71 @@ class ZmqDestination:
         topic: str = "evam_tpu",
         bind: bool = True,
         send_hwm: int = 1000,
+        max_backoff_s: float = 10.0,
     ):
+        self.topic = topic.encode()
+        self.endpoint = endpoint
+        self.bind = bind
+        self.send_hwm = send_hwm
+        self.max_backoff_s = max_backoff_s
+        self._dropped = 0
+        self._backoff = 0.5
+        self._next_retry = 0.0
+        self._sock = None
+        # The FIRST connect still raises (→ a 400 at the REST layer,
+        # e.g. two streams binding the same default endpoint): a
+        # misconfigured destination must fail the start request, not
+        # silently drop forever.
+        self._connect()
+
+    def _connect(self) -> None:
         import zmq
 
-        self.topic = topic.encode()
         self._ctx = zmq.Context.instance()
-        self._sock = self._ctx.socket(zmq.PUB)
+        sock = self._ctx.socket(zmq.PUB)
         # HWM gives the same backpressure knob as the reference's
         # zmq_recv_hwm (eii/config.json:37): overflow drops, the
         # engine never blocks on a slow consumer.
-        self._sock.setsockopt(zmq.SNDHWM, send_hwm)
-        self._sock.setsockopt(zmq.LINGER, 0)
+        sock.setsockopt(zmq.SNDHWM, self.send_hwm)
+        sock.setsockopt(zmq.LINGER, 0)
         try:
-            if bind:
-                self._sock.bind(endpoint)
+            if self.bind:
+                sock.bind(self.endpoint)
             else:
-                self._sock.connect(endpoint)
+                sock.connect(self.endpoint)
         except zmq.ZMQError as exc:
-            # Surfaces as a 400 at the REST layer (ValueError), e.g.
-            # two streams binding the same default endpoint.
-            self._sock.close(0)
+            sock.close(0)
             raise ValueError(
-                f"zmq destination endpoint {endpoint}: {exc}"
+                f"zmq destination endpoint {self.endpoint}: {exc}"
             ) from exc
-        log.info("zmq pub %s endpoint %s", "bound" if bind else "connected",
-                 endpoint)
+        self._sock = sock
+        log.info("zmq pub %s endpoint %s",
+                 "bound" if self.bind else "connected", self.endpoint)
+
+    def _ensure(self) -> bool:
+        if self._sock is not None:
+            return True
+        if time.monotonic() < self._next_retry:
+            return False
+        try:
+            self._connect()
+            self._backoff = 0.5
+            return True
+        except ValueError as exc:
+            self._next_retry = time.monotonic() + self._backoff
+            self._backoff = min(self._backoff * 2, self.max_backoff_s)
+            log.warning("zmq reconnect failed (%s); retry in %.1fs",
+                        exc, self._backoff)
+            return False
+
+    def _drop(self) -> None:
+        self._dropped += 1
+        metrics.inc("evam_publish_dropped", labels={"dest": "zmq"})
 
     def publish(self, meta: dict, frame: bytes | None = None) -> None:
+        if not self._ensure():
+            self._drop()
+            return
         parts = [self.topic, json.dumps(meta, separators=(",", ":")).encode()]
         if frame is not None:
             parts.append(frame)
@@ -58,7 +104,20 @@ class ZmqDestination:
         try:
             self._sock.send_multipart(parts, flags=zmq.NOBLOCK)
         except zmq.Again:
-            pass  # HWM reached: drop (slow-consumer backpressure)
+            self._drop()  # HWM reached: drop (slow-consumer backpressure)
+        except zmq.ZMQError as exc:
+            log.warning("zmq publish failed (%s); rebuilding socket", exc)
+            self._sock.close(0)
+            self._sock = None
+            self._next_retry = time.monotonic() + self._backoff
+            self._backoff = min(self._backoff * 2, self.max_backoff_s)
+            self._drop()
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
 
     def close(self) -> None:
-        self._sock.close(0)
+        if self._sock is not None:
+            self._sock.close(0)
+            self._sock = None

@@ -145,9 +145,20 @@ def build_app(
             ready["rtsp_demux"] = registry.rtsp_demux.stats()
         if registry.decode_pool is not None:
             ready["decode_pool"] = registry.decode_pool.stats()
+        # Engine-failure ladder, most severe first — all 503 so
+        # HTTP-status readiness probes (helm chart httpGet) actually
+        # take the pod out of rotation, but with DISTINCT statuses:
+        # `degraded` is terminal (restart budget exhausted — the pod
+        # needs restarting), `restarting` is transient (the supervisor
+        # is rebuilding a quarantined engine; rotation returns on its
+        # own), `stalled` is a wedge with supervision disabled.
+        if ready.get("degraded"):
+            return web.json_response(
+                {"status": "degraded", **ready}, status=503)
+        if ready.get("restarting"):
+            return web.json_response(
+                {"status": "restarting", **ready}, status=503)
         if ready.get("stalled"):
-            # 503 so HTTP-status readiness probes (helm chart httpGet)
-            # actually take the pod out of rotation
             return web.json_response(
                 {"status": "stalled", **ready}, status=503)
         status = "warming" if ready["warming"] else "ok"

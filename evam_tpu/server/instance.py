@@ -12,6 +12,7 @@ never takes the engine down (per-stream supervision, SURVEY.md §5.3).
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 import uuid
@@ -38,6 +39,25 @@ class InstanceState(str, enum.Enum):
     ABORTED = "ABORTED"
 
 
+def _retry_delay(
+    attempts: int,
+    base_s: float,
+    cap_s: float,
+    rng: random.Random | None = None,
+) -> float:
+    """Capped, jittered exponential reconnect backoff.
+
+    The raw ``base * 2**(attempts-1)`` is unbounded AND synchronized:
+    when a shared source (one camera feeding many pipelines) drops,
+    every stream fails in the same instant and retries on the same
+    schedule — a reconnect stampede against a device that commonly
+    allows a single connection. The cap bounds the wait; the ±25%
+    jitter decorrelates the herd."""
+    delay = min(base_s * (2 ** max(attempts - 1, 0)), cap_s)
+    jitter = (rng or random).uniform(-0.25, 0.25)
+    return max(0.05, delay * (1.0 + jitter))
+
+
 class StreamInstance:
     def __init__(
         self,
@@ -49,6 +69,7 @@ class StreamInstance:
         frame_sink: Callable[[FrameContext], None] | None = None,
         max_retries: int = 3,
         retry_backoff_s: float = 1.0,
+        max_backoff_s: float = 30.0,
         on_finish: Callable[["StreamInstance"], None] | None = None,
         source: Any | None = None,
         decode_pool: Any | None = None,
@@ -63,6 +84,7 @@ class StreamInstance:
         self.frame_sink = frame_sink
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        self.max_backoff_s = max_backoff_s
         self.on_finish = on_finish
         # Injected source (EII msgbus ingest): caller owns its
         # lifecycle, so no retry-recreate — a failure is permanent.
@@ -137,8 +159,11 @@ class StreamInstance:
                     if attempts > self.max_retries:
                         raise
                     # Source reconnect with backoff (reference leaves
-                    # this as a TODO, evas/publisher.py:253-255).
-                    delay = self.retry_backoff_s * (2 ** (attempts - 1))
+                    # this as a TODO, evas/publisher.py:253-255) —
+                    # capped and jittered so a shared-source outage
+                    # can't trigger a synchronized retry stampede.
+                    delay = _retry_delay(
+                        attempts, self.retry_backoff_s, self.max_backoff_s)
                     log.warning(
                         "stream %s attempt %d failed (%s); retrying in %.1fs",
                         self.id[:8], attempts, exc, delay,

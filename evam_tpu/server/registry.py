@@ -20,7 +20,7 @@ from evam_tpu.config import Settings
 from evam_tpu.engine.hub import EngineHub
 from evam_tpu.graph import PipelineLoader, resolve_parameters
 from evam_tpu.models.registry import ModelRegistry
-from evam_tpu.obs import get_logger
+from evam_tpu.obs import get_logger, metrics
 from evam_tpu.parallel.mesh import build_mesh
 from evam_tpu.publish.base import create_destination
 from evam_tpu.server.instance import InstanceState, StreamInstance
@@ -53,6 +53,11 @@ class PipelineRegistry:
                 deadline_ms=settings.tpu.batch_deadline_ms,
                 warmup=settings.tpu.warmup,
                 stall_timeout_s=settings.tpu.stall_timeout_s,
+                supervise=settings.tpu.supervise,
+                max_restarts=settings.tpu.max_restarts,
+                restart_window_s=settings.tpu.restart_window_s,
+                restart_backoff_s=settings.tpu.restart_backoff_s,
+                first_batch_grace=settings.tpu.first_batch_grace,
             )
         self.hub = hub
         #: shared decode pool (opt-in, EVAM_DECODE_POOL_WORKERS>0):
@@ -299,7 +304,14 @@ class PipelineRegistry:
             instances = list(self.instances.values())
         return [i.status() for i in instances]
 
-    def stop_all(self) -> None:
+    def stop_all(self) -> int:
+        """Drain every instance and shut the engines down. Returns the
+        number of LEAKED instances — worker threads still alive after
+        the per-instance drain budget (settings.drain_timeout_s). A
+        wedged stream must not hold shutdown hostage, but it must not
+        vanish silently either: stragglers are logged, counted in
+        ``evam_shutdown_leaked_streams``, and their persisted state is
+        flagged best-effort."""
         # Shutdown drain must keep streams.json intact: these streams
         # should re-attach on the next boot (unlike per-stream DELETE).
         with self._lock:
@@ -313,20 +325,29 @@ class PipelineRegistry:
         for inst in instances:
             inst.stop()
         for inst in instances:
-            inst.wait(timeout=5)
+            inst.wait(timeout=self.settings.drain_timeout_s)
         if self.decode_pool is not None:
             self.decode_pool.stop()
         if self.rtsp_demux is not None:
             self.rtsp_demux.stop()
-        for inst in active:
+        leaked = 0
+        for inst in instances:
             if inst._thread is not None and inst._thread.is_alive():
                 # wait() timed out: this worker may still assign ids
                 # after the snapshot below — warn, the persisted state
                 # is best-effort for a wedged stream
+                leaked += 1
                 log.warning(
                     "stream %s still draining at shutdown; persisted "
                     "state may lag", inst.id[:8],
                 )
+        metrics.set("evam_shutdown_leaked_streams", leaked)
+        if leaked:
+            log.error(
+                "shutdown drain abandoned %d straggler stream(s) after "
+                "%.1fs each (daemon threads; the process exit reaps "
+                "them)", leaked, self.settings.drain_timeout_s,
+            )
         # a DELETE racing shutdown must stay deleted (its persist
         # already excluded it), and a stream that finished NATURALLY
         # during the drain must not be replayed on the next boot —
@@ -337,6 +358,7 @@ class PipelineRegistry:
             and i.state not in (InstanceState.COMPLETED, InstanceState.ERROR)
         ])
         self.hub.stop()
+        return leaked
 
     # ------------------------------------------------- restart/resume
 

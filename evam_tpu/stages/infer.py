@@ -97,9 +97,11 @@ def _wire_frame(
 def _warm_engine(hub: EngineHub, engine, ingest_size, wire_format,
                  **extra_example) -> None:
     """Precompile the engine's batch buckets in the background when the
-    hub serves live traffic (hub.warmup)."""
-    if not hub.warmup:
-        return
+    hub serves live traffic (hub.warmup). The example is recorded on
+    the engine EITHER way (set_example): a supervised rebuild
+    (engine/supervisor.py) re-warms the replacement engine from it, so
+    recovery never pays the mid-traffic compile spike the original
+    warmup was added to kill."""
     h, w = ingest_size
     if wire_format == "seed":
         frame = np.uint32(0)
@@ -107,7 +109,10 @@ def _warm_engine(hub: EngineHub, engine, ingest_size, wire_format,
         from evam_tpu.ops.color import wire_shape
 
         frame = np.zeros(wire_shape(wire_format, h, w), np.uint8)
-    engine.warm_async(frames=frame, **extra_example)
+    if hub.warmup:
+        engine.warm_async(frames=frame, **extra_example)
+    else:
+        engine.set_example(frames=frame, **extra_example)
 
 
 class DetectStage(AsyncStage):

@@ -1,0 +1,51 @@
+"""Fast tier-1 variant of the chaos soak (tools/chaos_soak.py).
+
+Runs the full serving stack — realtime synthetic streams → warmed
+supervised engines — under a DETERMINISTIC fault shape
+(``wedge=1,wedge_n=1``: exactly the first post-warmup batch wedges)
+plus probabilistic drop/error noise, and asserts the supervision
+contract: streams complete, the supervisor quarantines + rebuilds the
+wedged engine within the restart budget, serving resumes, and
+readiness ends healthy.
+
+Marker-gated (``-m "not chaos"`` skips it) but NOT slow: it rides the
+tier-1 fast suite so every CI run exercises quarantine → rebuild →
+re-admission end to end. The long probabilistic shape stays in
+``python tools/chaos_soak.py`` for soak batteries.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.mark.chaos
+def test_chaos_soak_recovers_within_budget(eight_devices, monkeypatch):
+    from chaos_soak import run_soak
+
+    # run_soak sets the fault env itself; monkeypatch scopes the
+    # mutation to this test so later tests see a clean environment
+    monkeypatch.setenv("EVAM_FAULT_INJECT", "")
+    monkeypatch.setenv("EVAM_FAULT_SEED", "0")
+    result = run_soak(
+        streams=3,
+        frames=210,  # 7 s realtime @30fps — outlives the rebuild
+        fault="wedge=1,wedge_n=1,wedge_s=3,drop=0.02,error=0.01",
+        seed=7,
+        stall_timeout_s=1.0,
+        max_restarts=5,
+        restart_backoff_s=0.1,
+        timeout_s=120.0,
+    )
+    assert result["ok"], result
+    assert result["engine_restarts"] >= 1, result
+    assert result["wedges_injected"] == 1, result
+    assert not result["degraded_engines"], result
+    assert result["frames_out"] > 0, result
+    assert result["errors"] > 0, result  # the faults really fired

@@ -225,27 +225,29 @@ class TestSerializeCompile:
 
 
 class TestStallWatchdog:
-    def test_wedged_step_fails_futures_and_flags_engine(self):
+    def test_wedged_step_fails_futures_and_flags_engine(self, monkeypatch):
         """A device call that never returns (the axon-tunnel failure
         mode) must not strand callers: the watchdog fails in-flight
         and queued futures with TimeoutError, flags the engine, and
-        submit() starts rejecting."""
-        import threading as _t
-        from concurrent.futures import Future
-
+        submit() starts rejecting. The wedge is injected with the
+        `wedge` fault (obs/faults.py) — it blocks the dispatcher
+        inside _run exactly like a hung backend RPC — and hits a WARM
+        bucket; a cold bucket's first batch gets the compile grace
+        (test_first_batch_compile_grace below)."""
         from evam_tpu.engine.batcher import BatchEngine
-
-        release = _t.Event()
-
-        def wedged_step(params, frames):
-            release.wait(30)  # simulates a hung backend call
-            return frames
+        from evam_tpu.obs import faults
 
         eng = BatchEngine(
-            "wedged", wedged_step, params=None, max_batch=2,
+            "wedged", lambda p, frames: frames, params=None, max_batch=2,
             deadline_ms=1.0, stall_timeout_s=1.0,
         )
         try:
+            # warm the bucket: compile + one healthy round-trip
+            eng.submit(frames=np.zeros((2, 2), np.float32)).result(
+                timeout=30)
+            monkeypatch.setenv("EVAM_FAULT_INJECT",
+                               "wedge=1,wedge_n=1,wedge_s=6")
+            faults.reset_cache()
             f1 = eng.submit(frames=np.zeros((2, 2), np.float32))
             time.sleep(0.2)
             f2 = eng.submit(frames=np.zeros((2, 2), np.float32))
@@ -257,7 +259,45 @@ class TestStallWatchdog:
             with pytest.raises(RuntimeError, match="stalled"):
                 eng.submit(frames=np.zeros((2, 2), np.float32))
         finally:
-            release.set()  # unwedge so stop() can join threads
+            monkeypatch.setenv("EVAM_FAULT_INJECT", "")
+            faults.reset_cache()
+            # the dispatcher is mid-wedge: abandon (non-blocking)
+            # instead of stop()'s joins
+            eng.abandon()
+
+    def test_first_batch_compile_grace(self, monkeypatch):
+        """A cold bucket's first round-trip legitimately contains
+        trace + compile: the watchdog must budget it at
+        stall_timeout_s × first_batch_grace, or every supervisor
+        rebuild (fresh jit by design) would flap back into quarantine
+        on its first batch. Same slowness, two outcomes: absorbed on
+        the cold bucket, a stall once the bucket is warm."""
+        from evam_tpu.engine.batcher import BatchEngine
+        from evam_tpu.obs import faults
+
+        monkeypatch.setenv("EVAM_FAULT_INJECT",
+                           "wedge=1,wedge_n=2,wedge_s=0.9")
+        faults.reset_cache()
+        eng = BatchEngine(
+            "coldstart", lambda p, frames: frames, params=None,
+            max_batch=2, deadline_ms=1.0, stall_timeout_s=0.3,
+            first_batch_grace=10.0,
+        )
+        try:
+            # wedge #1 rides the cold first batch: 0.9 s > the plain
+            # 0.3 s budget but inside the 3 s grace — absorbed
+            out = eng.submit(
+                frames=np.zeros((2, 2), np.float32)).result(timeout=30)
+            assert out.shape == (2, 2)
+            assert not eng.stalled.is_set()
+            # wedge #2 hits the now-warm bucket: plain budget → stall
+            f = eng.submit(frames=np.zeros((2, 2), np.float32))
+            with pytest.raises(TimeoutError):
+                f.result(timeout=10)
+            assert eng.stalled.is_set()
+        finally:
+            monkeypatch.setenv("EVAM_FAULT_INJECT", "")
+            faults.reset_cache()
             eng.stop()
 
     def test_healthy_engine_never_trips_watchdog(self):

@@ -86,10 +86,18 @@ class TestMqtt:
         assert broker.published[1][1] == b"\x01\x02"
 
     def test_unreachable_broker_drops_not_raises(self):
+        from evam_tpu.obs.metrics import metrics
+
+        before = metrics.get_counter("evam_publish_dropped",
+                                     labels={"dest": "mqtt"})
         dest = MqttDestination("127.0.0.1", 1, topic="x", max_backoff=0.1)
         for _ in range(3):
             dest.publish({"n": 1})
         assert dest.dropped >= 1
+        # losses land in the shared cross-destination drop metric
+        assert metrics.get_counter(
+            "evam_publish_dropped", labels={"dest": "mqtt"}
+        ) - before == dest.dropped
         dest.close()
 
 
@@ -118,6 +126,39 @@ class TestZmq:
         sub.close(0)
         dest.close()
 
+    def test_bad_endpoint_still_raises_at_start(self):
+        # first-connect failures must surface as a 400 at the REST
+        # layer, not silently drop forever
+        with pytest.raises(ValueError, match="zmq destination"):
+            ZmqDestination("tcp://256.256.256.256:1", topic="x")
+
+    def test_disconnected_socket_drops_counts_and_reconnects(self):
+        from evam_tpu.obs.metrics import metrics
+
+        port_probe = socket.socket()
+        port_probe.bind(("127.0.0.1", 0))
+        port = port_probe.getsockname()[1]
+        port_probe.close()
+        before = metrics.get_counter("evam_publish_dropped",
+                                     labels={"dest": "zmq"})
+        dest = ZmqDestination(f"tcp://127.0.0.1:{port}", topic="x",
+                              max_backoff_s=0.2)
+        # simulate a send failure's aftermath: socket torn down,
+        # reconnect scheduled — publishes inside the backoff window
+        # drop with accounting, then the socket rebuilds
+        dest._sock.close(0)
+        dest._sock = None
+        dest._next_retry = time.monotonic() + 0.15
+        dest.publish({"n": 1})
+        assert dest.dropped == 1
+        assert metrics.get_counter(
+            "evam_publish_dropped", labels={"dest": "zmq"}) - before == 1
+        time.sleep(0.2)
+        dest.publish({"n": 2})  # past the backoff: rebinds and sends
+        assert dest._sock is not None
+        assert dest.dropped == 1
+        dest.close()
+
 
 class TestFileAndFactory:
     def test_json_lines(self, tmp_path):
@@ -136,6 +177,28 @@ class TestFileAndFactory:
         d.publish({"a": 2})
         d.close()
         assert json.loads(p.read_text()) == [{"a": 1}, {"a": 2}]
+
+    def test_write_failure_drops_counts_and_recovers(self, tmp_path):
+        from evam_tpu.obs.metrics import metrics
+
+        missing_dir = tmp_path / "not-yet"
+        p = missing_dir / "r.jsonl"
+        before = metrics.get_counter("evam_publish_dropped",
+                                     labels={"dest": "file"})
+        d = FileDestination(str(p), retry_backoff_s=0.1, max_backoff_s=0.5)
+        d.publish({"a": 1})  # open fails (missing dir): drop, no raise
+        assert d.dropped == 1
+        assert metrics.get_counter(
+            "evam_publish_dropped", labels={"dest": "file"}) - before == 1
+        d.publish({"a": 2})  # inside the backoff window: dropped too
+        assert d.dropped == 2
+        missing_dir.mkdir()
+        time.sleep(0.25)  # past the (doubled) backoff
+        d.publish({"a": 3})  # recovered: opens and writes
+        d.close()
+        rows = [json.loads(l) for l in p.read_text().splitlines()]
+        assert rows == [{"a": 3}]
+        assert d.dropped == 2
 
     def test_factory(self, tmp_path):
         assert isinstance(create_destination(None), NullDestination)

@@ -1,0 +1,195 @@
+"""Chaos soak: N streams vs a supervised hub under injected faults.
+
+Drives the full serving stack — synthetic sources → StreamRunner →
+shared supervised BatchEngines — with ``EVAM_FAULT_INJECT`` active
+(wedge/drop/error, obs/faults.py) and asserts the continuous-operation
+contract the EngineSupervisor exists for:
+
+* every stream COMPLETES (faults degrade frames, never kill streams);
+* injected ``wedge`` faults trip the stall watchdog, the supervisor
+  quarantines + rebuilds the engine, and serving resumes — within the
+  restart budget (no engine ends the run ``degraded``);
+* the readiness payload (/healthz shape) is back to healthy at the end.
+
+Usage (defaults are the CI-adjacent quick shape):
+
+    python tools/chaos_soak.py --streams 4 --frames 210 \
+        --fault "wedge=1,wedge_n=1,wedge_s=3,drop=0.02,error=0.01" \
+        --seed 7 --stall-timeout 1.0
+
+Engines are built and WARMED before the faults arm (the chaos scenario
+is a wedge hitting a serving engine mid-traffic, and a warm bucket is
+what the watchdog holds to its plain budget — cold first batches get
+the compile grace). The deterministic shape ``wedge=1,wedge_n=K``
+then wedges exactly the next K dispatched batches, so the run asserts
+>= K restarts instead of hoping a probability fires.
+``tests/test_chaos.py`` wires a fast marker-gated variant of exactly
+this entrypoint into the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def run_soak(
+    streams: int = 4,
+    frames: int = 210,
+    fault: str = "wedge=1,wedge_n=1,wedge_s=3,drop=0.02,error=0.01",
+    seed: int = 7,
+    stall_timeout_s: float = 1.0,
+    max_restarts: int = 5,
+    restart_window_s: float = 120.0,
+    restart_backoff_s: float = 0.1,
+    min_restarts: int | None = None,
+    timeout_s: float = 240.0,
+) -> dict:
+    """Run the soak; returns a summary dict with ``ok``. Importable —
+    the tier-1 chaos test calls this with a small shape."""
+    from evam_tpu.config import Settings
+    from evam_tpu.engine import EngineHub
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+    from evam_tpu.obs import faults
+    from evam_tpu.obs.metrics import metrics
+    from evam_tpu.parallel import build_mesh
+    from evam_tpu.server.registry import PipelineRegistry
+
+    # faults stay DISARMED until the engines are built and warm — the
+    # chaos scenario is a wedge hitting a SERVING engine mid-traffic,
+    # and a warm bucket is what lets the stall watchdog apply its
+    # plain (not first-batch compile grace) budget to the wedge
+    os.environ["EVAM_FAULT_INJECT"] = ""
+    faults.reset_cache()
+    small = {k: (64, 64) for k in ZOO_SPECS}
+    small["audio_detection/environment"] = (1, 1600)
+    narrow = {k: 8 for k in ZOO_SPECS}
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    hub = EngineHub(
+        ModelRegistry(dtype="float32", input_overrides=small,
+                      width_overrides=narrow),
+        plan=build_mesh(), max_batch=16, deadline_ms=4.0,
+        warmup=True, stall_timeout_s=stall_timeout_s,
+        supervise=True, max_restarts=max_restarts,
+        restart_window_s=restart_window_s,
+        restart_backoff_s=restart_backoff_s,
+    )
+    registry = PipelineRegistry(settings, hub=hub)
+    registry.preload("object_detection/person_vehicle_bike")
+    warm_deadline = time.time() + 180
+    while time.time() < warm_deadline:
+        ready = hub.readiness()
+        if ready["engines"] and not ready["warming"]:
+            break
+        time.sleep(0.1)
+    else:
+        registry.stop_all()
+        raise RuntimeError("engines never warmed; cannot arm chaos")
+    os.environ["EVAM_FAULT_INJECT"] = fault
+    os.environ["EVAM_FAULT_SEED"] = str(seed)
+    faults.reset_cache()
+    # the metrics registry is process-global: report deltas so a soak
+    # embedded in a larger run (tests/test_chaos.py) doesn't count
+    # earlier tests' faults/restarts
+    wedges0 = metrics.get_counter(
+        "evam_faults_injected", labels={"kind": "wedge"})
+    t0 = time.time()
+    # wedge count the deterministic fault shape guarantees (see module
+    # docstring); probabilistic shapes pass min_restarts explicitly
+    if min_restarts is None:
+        cfg = dict(
+            kv.split("=") for kv in fault.split(",") if "=" in kv)
+        min_restarts = (int(float(cfg.get("wedge_n", 0)))
+                        if float(cfg.get("wedge", 0)) >= 1.0 else 0)
+    try:
+        insts = [
+            registry.start_instance(
+                "object_detection", "person_vehicle_bike",
+                {
+                    # realtime pacing: the stream must OUTLIVE the
+                    # wedge→rebuild cycles (a free-running synthetic
+                    # source burns every frame into the error path
+                    # while the engine is quarantined and completes
+                    # before recovery can be observed)
+                    "source": {
+                        "uri": f"synthetic://96x96@30?count={frames}"
+                               f"&seed={i}",
+                        "type": "uri",
+                        "realtime": True,
+                    },
+                    "destination": {"metadata": {"type": "null"}},
+                },
+            )
+            for i in range(streams)
+        ]
+        deadline = t0 + timeout_s
+        for inst in insts:
+            inst.wait(timeout=max(1.0, deadline - time.time()))
+        states = [i.state.value for i in insts]
+        frames_out = sum(
+            i._runner.frames_out if i._runner else 0 for i in insts)
+        errors = sum(i._runner.errors if i._runner else 0 for i in insts)
+        ready = hub.readiness()
+        eng = hub.stats()
+        restarts = sum(v.get("restarts", 0) for v in eng.values())
+        degraded = [k for k, v in eng.items() if v.get("state") == "degraded"]
+        wedges = metrics.get_counter(
+            "evam_faults_injected", labels={"kind": "wedge"}) - wedges0
+    finally:
+        registry.stop_all()
+    ok = (
+        all(s == "COMPLETED" for s in states)
+        and not degraded
+        and restarts >= min_restarts
+        and ready.get("restarting", 0) == 0
+        and frames_out > 0
+    )
+    return {
+        "ok": ok,
+        "streams": streams,
+        "states": states,
+        "frames_out": frames_out,
+        "errors": errors,
+        "wedges_injected": int(wedges),
+        "engine_restarts": restarts,
+        "min_restarts": min_restarts,
+        "degraded_engines": degraded,
+        "readiness": ready,
+        "elapsed_s": round(time.time() - t0, 1),
+        "fault": fault,
+        "seed": seed,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--streams", type=int, default=4)
+    p.add_argument("--frames", type=int, default=210)
+    p.add_argument("--fault", default=(
+        "wedge=1,wedge_n=1,wedge_s=3,drop=0.02,error=0.01"))
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--stall-timeout", type=float, default=1.0)
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--min-restarts", type=int, default=None,
+                   help="override the wedge_n-derived recovery floor")
+    p.add_argument("--timeout", type=float, default=240.0)
+    args = p.parse_args()
+    result = run_soak(
+        streams=args.streams, frames=args.frames, fault=args.fault,
+        seed=args.seed, stall_timeout_s=args.stall_timeout,
+        max_restarts=args.max_restarts, min_restarts=args.min_restarts,
+        timeout_s=args.timeout,
+    )
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
