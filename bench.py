@@ -395,6 +395,13 @@ def run_serve_bench(args) -> dict:
             v.get("restarts", 0) for v in eng_stats.values())
         engine_states = {
             k: v.get("state", "running") for k, v in eng_stats.items()}
+        # QoS-layer outcome (evam_tpu/sched/): per-class admission and
+        # shed counts on the contract line, from the reset-proof local
+        # counters (the window-scoped metrics.reset() above must not
+        # erase them). All-zero shed/rejected = the run never hit the
+        # overload ladder.
+        sched_counts = reg.admission.counts()
+        sched_shed = reg.hub.shed_totals()
         demux_stats = (reg.rtsp_demux.stats()
                        if reg.rtsp_demux is not None else None)
     finally:
@@ -433,6 +440,9 @@ def run_serve_bench(args) -> dict:
         "dead_streams": dead,
         "engine_restarts": engine_restarts,
         "engine_states": engine_states,
+        "sched_admitted": sched_counts["admitted"],
+        "sched_rejected": sched_counts["rejected"],
+        "sched_shed": sched_shed,
         **({"demux": demux_stats} if demux_stats else {}),
     }
 

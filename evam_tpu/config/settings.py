@@ -68,6 +68,39 @@ class TPUSettings(BaseModel):
     first_batch_grace: float = 10.0
 
 
+class SchedSettings(BaseModel):
+    """QoS scheduling knobs (evam_tpu/sched/): admission control,
+    priority classes, load shedding. ``EVAM_SCHED=off`` disables the
+    whole layer — engines keep the legacy single-FIFO dispatch,
+    byte-identical (A/B, like EVAM_BATCH_ASSEMBLY=legacy)."""
+
+    enabled: bool = True
+    #: projected-utilization ceiling for admission control; a start
+    #: that would push demand/capacity past it is rejected 503 +
+    #: Retry-After (classes get headroom-scaled ceilings — batch is
+    #: turned away first, realtime last). 0 disables admission.
+    admit_util: float = 0.85
+    #: operator-declared serving capacity in frames/s; 0 = derive it
+    #: from live EngineStats stage timings (a cold hub admits all)
+    capacity_fps: float = 0.0
+    #: assumed per-stream fps when a start request declares none
+    default_fps: float = 30.0
+    #: per-class batch-formation deadlines (ms): cameras keep a small
+    #: latency floor, bulk traffic fills big buckets. Unless
+    #: explicitly set, the standard class inherits the engine-level
+    #: EVAM_BATCH_DEADLINE_MS (SchedConfig.from_settings) — turning
+    #: the scheduler on must not repeal a tuned global deadline.
+    deadline_ms_realtime: float = 4.0
+    deadline_ms_standard: float = 8.0
+    deadline_ms_batch: float = 25.0
+    #: per-class staleness budgets (ms): frames older than this at
+    #: dispatch are shed oldest-first (freshest-frame-wins) with
+    #: their futures failed as ShedError. 0 = never shed that class.
+    staleness_ms_realtime: float = 200.0
+    staleness_ms_standard: float = 1000.0
+    staleness_ms_batch: float = 5000.0
+
+
 class Settings(BaseModel):
     """Flat service settings resolved from env + optional config file."""
 
@@ -108,6 +141,7 @@ class Settings(BaseModel):
     #: never waited on indefinitely
     drain_timeout_s: float = 5.0
     tpu: TPUSettings = Field(default_factory=TPUSettings)
+    sched: SchedSettings = Field(default_factory=SchedSettings)
 
     @classmethod
     def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
@@ -159,6 +193,26 @@ class Settings(BaseModel):
             for var, (key, conv) in tpu_mapping.items():
                 if var in env:
                     tpu[key] = conv(env[var])
+
+        sched = data.setdefault("sched", {})
+        sched_mapping = {
+            "EVAM_SCHED": ("enabled", _parse_bool),
+            "EVAM_SCHED_ADMIT_UTIL": ("admit_util", float),
+            "EVAM_SCHED_CAPACITY_FPS": ("capacity_fps", float),
+            "EVAM_SCHED_DEFAULT_FPS": ("default_fps", float),
+            "EVAM_SCHED_DEADLINE_MS_REALTIME": ("deadline_ms_realtime", float),
+            "EVAM_SCHED_DEADLINE_MS_STANDARD": ("deadline_ms_standard", float),
+            "EVAM_SCHED_DEADLINE_MS_BATCH": ("deadline_ms_batch", float),
+            "EVAM_SCHED_STALENESS_MS_REALTIME": (
+                "staleness_ms_realtime", float),
+            "EVAM_SCHED_STALENESS_MS_STANDARD": (
+                "staleness_ms_standard", float),
+            "EVAM_SCHED_STALENESS_MS_BATCH": ("staleness_ms_batch", float),
+        }
+        if isinstance(sched, dict):
+            for var, (key, conv) in sched_mapping.items():
+                if var in env:
+                    sched[key] = conv(env[var])
         return cls.model_validate(data)
 
 

@@ -58,6 +58,13 @@ from evam_tpu.engine.ringbuf import STAGES, SealedBatch, SlotRing
 from evam_tpu.obs import get_logger, metrics
 from evam_tpu.obs.faults import from_env as faults_from_env
 from evam_tpu.parallel.mesh import MeshPlan
+from evam_tpu.sched.classes import (
+    DEFAULT_PRIORITY,
+    PRIORITIES,
+    ClassQueues,
+    SchedConfig,
+)
+from evam_tpu.sched.shedder import Shedder
 
 log = get_logger("engine.batcher")
 
@@ -67,6 +74,7 @@ class _WorkItem:
     inputs: dict[str, np.ndarray]
     future: Future
     t_submit: float
+    priority: str = DEFAULT_PRIORITY
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -137,6 +145,7 @@ class BatchEngine:
         staging_depth: int | None = None,
         donate_inputs: bool | None = None,
         first_batch_grace: float = 10.0,
+        sched: SchedConfig | None = None,
     ):
         self.name = name
         self.plan = plan
@@ -153,6 +162,15 @@ class BatchEngine:
             raise ValueError(
                 f"EVAM_BATCH_ASSEMBLY must be 'slot' or 'legacy', "
                 f"got {self.assembly!r}")
+        #: QoS scheduling (evam_tpu/sched/): when set (and enabled),
+        #: submit routes into per-class queues drained realtime-first
+        #: with per-class batch deadlines and staleness shedding.
+        #: None/disabled = the legacy single-FIFO path, byte-identical
+        #: (EVAM_SCHED=off A/B).
+        self.sched = sched if (sched is not None and sched.enabled) else None
+        self._classq = ClassQueues() if self.sched is not None else None
+        self._shedder = (Shedder(name, self.sched.staleness_s())
+                         if self.sched is not None else None)
         #: watchdog bound on one batch's device round-trip; a wedged
         #: backend (e.g. a dead TPU tunnel) blocks the dispatcher in
         #: C++ forever — the watchdog can't unblock it, but it CAN
@@ -238,8 +256,12 @@ class BatchEngine:
         self.warmed = threading.Event()
         self._in_flight = threading.Semaphore(max_in_flight)
         self._stop = threading.Event()
-        dispatch_loop = (self._dispatch_loop_slot if self._ring is not None
-                         else self._dispatch_loop_legacy)
+        if self._classq is not None:
+            dispatch_loop = self._dispatch_loop_sched
+        elif self._ring is not None:
+            dispatch_loop = self._dispatch_loop_slot
+        else:
+            dispatch_loop = self._dispatch_loop_legacy
         self._dispatcher = threading.Thread(
             target=self._thread_guard, args=(dispatch_loop,),
             name=f"engine-{name}-dispatch", daemon=True,
@@ -272,13 +294,21 @@ class BatchEngine:
 
     # ------------------------------------------------------------- API
 
-    def submit(self, **inputs: np.ndarray) -> Future:
+    def submit(self, priority: str = DEFAULT_PRIORITY,
+               **inputs: np.ndarray) -> Future:
         """Enqueue one item (no batch dim); resolves to its packed row(s).
+
+        ``priority`` selects the scheduling class (realtime|standard|
+        batch) when the engine runs the QoS layer (evam_tpu/sched/);
+        without it the argument is accepted and ignored — the legacy
+        single-FIFO path stays byte-identical.
 
         On the slot path this call COPIES the item's arrays into the
         staging block on the calling thread (ringbuf.write) — the
         dispatcher never re-stacks them — and blocks only when every
-        staging slot is in flight (host-side backpressure)."""
+        staging slot is in flight (host-side backpressure). On the
+        sched path the copy moves to the dispatcher (class-ordered
+        dispatch needs the item mobile until it is picked)."""
         if self._stop.is_set():
             raise RuntimeError(f"engine {self.name} is stopped")
         if self.stalled.is_set():
@@ -293,6 +323,17 @@ class BatchEngine:
                 f"engine {self.name} expects inputs {self.input_names}, got {tuple(inputs)}"
             )
         fut: Future = Future()
+        if self._classq is not None:
+            if priority not in PRIORITIES:
+                raise ValueError(
+                    f"unknown priority {priority!r}; valid: "
+                    f"{'|'.join(PRIORITIES)}")
+            item = _WorkItem(inputs, fut, time.perf_counter(), priority)
+            try:
+                self._classq.put(priority, item)
+            except RuntimeError:
+                raise RuntimeError(f"engine {self.name} is stopped") from None
+            return fut
         item = _WorkItem(inputs, fut, time.perf_counter())
         if self._ring is not None:
             try:
@@ -302,6 +343,40 @@ class BatchEngine:
         else:
             self._queue.put(item)
         return fut
+
+    def queue_depth(self) -> int:
+        """Items submitted but not yet dispatched — the previously
+        invisible backlog (satellite: queue gauges)."""
+        if self._classq is not None:
+            return self._classq.depth()
+        if self._ring is not None:
+            return self._ring.pending_items()
+        return self._queue.qsize()
+
+    def queue_age_s(self) -> float:
+        """Age (s) of the oldest undispatched item; 0 when idle."""
+        now = time.perf_counter()
+        if self._classq is not None:
+            return self._classq.oldest_age_s(now)
+        if self._ring is not None:
+            return self._ring.oldest_age_s(now)
+        with self._queue.mutex:
+            head = self._queue.queue[0] if self._queue.queue else None
+        if isinstance(head, _WorkItem):
+            return max(0.0, now - head.t_submit)
+        return 0.0
+
+    def class_depths(self) -> dict[str, int]:
+        """Per-class queued depth ({} when scheduling is off)."""
+        if self._classq is None:
+            return {}
+        return self._classq.depth_by_class()
+
+    def shed_counts(self) -> dict[str, int]:
+        """Per-class shed totals ({} when scheduling is off)."""
+        if self._shedder is None:
+            return {}
+        return dict(self._shedder.counts)
 
     def warmup(self) -> None:
         """Compile every bucket size ahead of traffic."""
@@ -345,6 +420,8 @@ class BatchEngine:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._classq is not None:
+            self._classq.close()
         if self._ring is not None:
             self._ring.close()
         self._queue.put(None)
@@ -352,6 +429,9 @@ class BatchEngine:
         self._done.put(None)
         self._completer.join(timeout=10)
         exc = RuntimeError("engine stopped")
+        if self._classq is not None:
+            for item in self._classq.drain():
+                _safe_set_exception(item.future, exc)
         if self._ring is not None:
             for item in self._ring.drain_items():
                 _safe_set_exception(item.future, exc)
@@ -404,6 +484,10 @@ class BatchEngine:
             f"engine {self.name} quarantined: wedged device call; "
             "the supervisor is rebuilding the engine"
         )
+        if self._classq is not None:
+            self._classq.close()
+            for item in self._classq.drain():
+                _safe_set_exception(item.future, exc)
         if self._ring is not None:
             self._ring.close()
             for item in self._ring.drain_items():
@@ -477,14 +561,105 @@ class BatchEngine:
         self.stats.items += n
         self.stats.occupancy_sum += n / b
         metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
-        depth = (self._ring.pending_items() if self._ring is not None
-                 else self._queue.qsize())
-        metrics.set("evam_engine_queue_depth", depth, {"engine": self.name})
+        metrics.set("evam_engine_queue_depth", self.queue_depth(),
+                    {"engine": self.name})
+        metrics.set("evam_engine_queue_age_s", self.queue_age_s(),
+                    {"engine": self.name})
         for stage, dt in clock.items():
             self.stats.add_stage(stage, dt)
             metrics.observe(
                 "evam_engine_stage_seconds", dt,
                 {"engine": self.name, "stage": stage})
+
+    # ------------------------------------------------ sched dispatch
+
+    def _dispatch_loop_sched(self) -> None:
+        """QoS dispatch (evam_tpu/sched/): drain per-class queues
+        realtime-first (starvation-proof weighted pick), form batches
+        under the CLASS deadline — cameras keep a small latency floor
+        while bulk traffic fills big buckets — and shed frames that
+        outlived their class staleness budget (oldest-first) before
+        they waste a device slot."""
+        cq = self._classq
+        shedder = self._shedder
+        while True:
+            if self._stop.is_set():
+                exc = RuntimeError("engine stopped")
+                for it in cq.drain():
+                    _safe_set_exception(it.future, exc)
+                break
+            # shed expired waiters across ALL classes first: the
+            # backlog a busy realtime lane starves must fail loudly
+            # instead of rotting in queue
+            shedder.sweep(cq)
+            cls = cq.pick(timeout=0.05)
+            if cls is None:
+                continue
+            items = cq.collect(cls, self.max_batch,
+                               self.sched.deadline_s(cls))
+            # the batch-formation wait itself can age items past
+            # budget (and a realtime burst can delay a picked batch
+            # class) — filter the formed batch too
+            items = shedder.shed(cls, items)
+            if not items:
+                continue
+            self._launch_sched(items)
+
+    def _launch_sched(self, items: list[_WorkItem]) -> None:
+        """Assemble + launch one class-ordered batch: through the
+        staging ring (zero per-batch allocation, copies on this
+        thread) or the legacy stack+concat when
+        EVAM_BATCH_ASSEMBLY=legacy."""
+        clock: dict[str, float] = {
+            "submit_wait": time.perf_counter() - items[0].t_submit,
+        }
+        sealed = None
+        if self._ring is not None:
+            try:
+                sealed = self._ring.stage_direct(
+                    [(it.inputs, it) for it in items],
+                    self._bucket, clock)
+            except RuntimeError:
+                exc = RuntimeError(f"engine {self.name} is stopped")
+                for it in items:
+                    _safe_set_exception(it.future, exc)
+                return
+            if sealed is None:
+                return  # every row failed its shape check
+            items, batch = sealed.items, sealed.arrays
+            n, b = sealed.n, sealed.bucket
+        else:
+            n = len(items)
+            b = self._bucket(n)
+            t_asm = time.perf_counter()
+            batch = {}
+            for name in self.input_names:
+                rows = [it.inputs[name] for it in items]
+                stacked = np.stack(rows)
+                if b > n:
+                    pad = np.zeros((b - n,) + stacked.shape[1:],
+                                   stacked.dtype)
+                    stacked = np.concatenate([stacked, pad])
+                batch[name] = stacked
+            clock["slot_write"] = time.perf_counter() - t_asm
+
+        self._in_flight.acquire()
+        t0 = time.perf_counter()
+        bid = self._track_dispatch(t0, items, b)
+        try:
+            out = self._run(batch, clock=clock)
+        except Exception as exc:  # noqa: BLE001 — surface to every caller
+            self._in_flight.release()
+            with self._exec_lock:
+                self._outstanding.pop(bid, None)
+            for it in items:
+                _safe_set_exception(it.future, exc)
+            if sealed is not None:
+                self._ring.release(sealed)
+            log.exception("engine %s step failed", self.name)
+            return
+        self._done.put((out, items, t0, bid, sealed))
+        self._record_batch(n, b, clock)
 
     # ------------------------------------------------- slot dispatch
 
@@ -672,7 +847,11 @@ class BatchEngine:
             )
             for it in stuck:
                 _safe_set_exception(it.future, exc)
-            # strand nothing in the staging ring or queue either
+            # strand nothing in the class queues, staging ring or
+            # legacy queue either
+            if self._classq is not None:
+                for it in self._classq.drain():
+                    _safe_set_exception(it.future, exc)
             if self._ring is not None:
                 for it in self._ring.drain_items():
                     _safe_set_exception(it.future, exc)

@@ -16,8 +16,9 @@ from evam_tpu.engine import steps as step_builders
 from evam_tpu.engine.batcher import BatchEngine
 from evam_tpu.engine.supervisor import SupervisedEngine
 from evam_tpu.models.registry import LoadedModel, ModelRegistry
-from evam_tpu.obs import get_logger
+from evam_tpu.obs import get_logger, metrics
 from evam_tpu.parallel.mesh import MeshPlan
+from evam_tpu.sched.classes import PRIORITIES, SchedConfig
 
 log = get_logger("engine.hub")
 
@@ -48,6 +49,7 @@ class EngineHub:
         restart_window_s: float = 300.0,
         restart_backoff_s: float = 0.5,
         first_batch_grace: float = 10.0,
+        sched: SchedConfig | None = None,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -76,6 +78,12 @@ class EngineHub:
         #: stall-watchdog multiplier for a bucket's first (compiling)
         #: batch — see BatchEngine._track_dispatch
         self.first_batch_grace = first_batch_grace
+        #: QoS scheduling config (evam_tpu/sched/): engines get
+        #: per-class queues, deadlines and staleness shedding. Part of
+        #: the rebuild recipe — a supervisor-rebuilt engine inherits
+        #: the class queues because the factory closure carries it.
+        #: None = the legacy single-FIFO engines (EVAM_SCHED=off).
+        self.sched = sched if (sched is not None and sched.enabled) else None
         self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
@@ -176,6 +184,7 @@ class EngineHub:
                 input_names=input_names,
                 stall_timeout_s=self.stall_timeout_s,
                 first_batch_grace=self.first_batch_grace,
+                sched=self.sched,
             )
 
         if not self.supervise:
@@ -232,6 +241,13 @@ class EngineHub:
                     "state": getattr(e, "state", "running"),
                     "restarts": getattr(e, "restarts", 0),
                     "last_stall_ts": getattr(e, "last_stall_ts", None),
+                    # submit-queue visibility (sched satellite): the
+                    # backlog that used to be invisible until the
+                    # stall watchdog tripped
+                    "queue_depth": e.queue_depth(),
+                    "queue_age_s": round(e.queue_age_s(), 3),
+                    # per-class depths when the QoS layer is on
+                    "sched_queues": e.class_depths(),
                 }
                 for k, e in self._engines.items()
             }
@@ -255,6 +271,51 @@ class EngineHub:
                 if batches else 0.0)
             for s in STAGES
         }
+
+    def queue_summary(self) -> dict[str, float]:
+        """Aggregate submit-queue backlog for /healthz (fixed keys —
+        golden contract): total undispatched items and the oldest
+        item's age across every engine. Refreshes the per-engine
+        gauges on the way so a scrape sees live values even between
+        dispatches (the whole point: backlog must be visible BEFORE
+        the stall watchdog fires)."""
+        with self._lock:
+            engines = dict(self._engines)
+        depth = 0
+        oldest = 0.0
+        for k, e in engines.items():
+            d = e.queue_depth()
+            age = e.queue_age_s()
+            depth += d
+            oldest = max(oldest, age)
+            metrics.set("evam_engine_queue_depth", d, {"engine": k})
+            metrics.set("evam_engine_queue_age_s", age, {"engine": k})
+        return {"depth": depth, "oldest_age_s": round(oldest, 3)}
+
+    def class_queue_depths(self) -> dict[str, int]:
+        """Summed per-class queued depth across engines (zeros when
+        the QoS layer is off — the /scheduler payload keeps a stable
+        shape either way)."""
+        out = {c: 0 for c in PRIORITIES}
+        with self._lock:
+            engines = list(self._engines.values())
+        for e in engines:
+            for c, n in e.class_depths().items():
+                out[c] = out.get(c, 0) + n
+        return out
+
+    def shed_totals(self) -> dict[str, int]:
+        """Summed per-class shed counts across live engines. NOTE: a
+        supervisor rebuild resets its engine's local counts; the
+        monotonic series is evam_sched_shed_total{class} in /metrics
+        — this is the live-engine view for /healthz and the bench."""
+        out = {c: 0 for c in PRIORITIES}
+        with self._lock:
+            engines = list(self._engines.values())
+        for e in engines:
+            for c, n in e.shed_counts().items():
+                out[c] = out.get(c, 0) + n
+        return out
 
     def readiness(self) -> dict[str, int]:
         """Engine warm state for /healthz (serve-time preload,

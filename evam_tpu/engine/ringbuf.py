@@ -314,6 +314,82 @@ class SlotRing:
                 n += self._open.count
             return n
 
+    def oldest_age_s(self, now: float | None = None) -> float:
+        """Age of the oldest staged-but-undispatched work (seconds):
+        the earliest first-write time across full + open slots. The
+        queue-age gauge's slot-path source — an invisible backlog
+        shows up here long before the stall watchdog would trip."""
+        now = time.perf_counter() if now is None else now
+        with self._cv:
+            firsts = [s.t_first for s in self._full if s.count]
+            if self._open is not None and self._open.count:
+                firsts.append(self._open.t_first)
+        return max(0.0, now - min(firsts)) if firsts else 0.0
+
+    # ------------------------------------------- dispatcher-side staging
+
+    def stage_direct(self, staged: list[tuple[dict, Any]], bucket_fn,
+                     clock: dict[str, float]) -> SealedBatch | None:
+        """Stage a dispatcher-assembled batch into a free slot (the
+        sched path: items arrive from per-class queues, so the row
+        copies happen HERE on the dispatcher thread instead of on the
+        submitting stream threads — the trade the QoS layer makes for
+        class-ordered dispatch, still zero per-batch allocation).
+
+        ``staged`` is ``[(inputs, item), ...]`` in dispatch order. A
+        row whose arrays mismatch the ring shapes fails only ITS
+        item's future; survivors compact into contiguous rows. Blocks
+        while every slot is in flight (the same host-side
+        backpressure as the submit path); raises RuntimeError once
+        the ring is closed; returns None when no row survived."""
+        first = {k: np.asarray(v) for k, v in staged[0][0].items()}
+        with self._cv:
+            if self._shapes is None:
+                self._allocate(first)
+            while not self._free and not self._closed:
+                self._cv.wait(0.1)
+            if self._closed:
+                raise RuntimeError("staging ring is closed")
+            slot = self._free.popleft()
+        t0 = time.perf_counter()
+        ok_items: list = []
+        row = 0
+        for inputs, item in staged:
+            try:
+                arrays = {k: np.asarray(v) for k, v in inputs.items()}
+                self._check_shapes(arrays)
+                for name, a in arrays.items():
+                    slot.arrays[name][row] = a
+            except Exception as exc:  # noqa: BLE001 — fail only this item
+                try:
+                    item.future.set_exception(exc)
+                except Exception:  # noqa: BLE001 — already resolved
+                    pass
+                continue
+            ok_items.append(item)
+            row += 1
+        clock["slot_write"] = time.perf_counter() - t0
+        if not ok_items:
+            with self._cv:
+                slot.count = 0
+                slot.items = []
+                slot.closed = False
+                slot.gen += 1
+                self._free.append(slot)
+                self._cv.notify_all()
+            return None
+        t1 = time.perf_counter()
+        n = row
+        bucket = bucket_fn(n)
+        dirty = min(slot.high, bucket)
+        for arr in slot.arrays.values():
+            if dirty > n:
+                arr[n:dirty] = 0
+        views = {k: a[:bucket] for k, a in slot.arrays.items()}
+        clock["seal"] = time.perf_counter() - t1
+        slot.count = n
+        return SealedBatch(slot, views, ok_items, n, bucket, clock)
+
     # -------------------------------------------------------- internals
 
     def _allocate(self, example: dict[str, np.ndarray]) -> None:

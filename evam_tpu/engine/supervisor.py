@@ -101,7 +101,7 @@ class SupervisedEngine:
 
     # ------------------------------------------------------------- API
 
-    def submit(self, **inputs) -> Future:
+    def submit(self, priority: str = "standard", **inputs) -> Future:
         with self._lock:
             state = self.state
             eng = self._engine
@@ -120,7 +120,7 @@ class SupervisedEngine:
                 f"engine {self.name} is restarting after a wedge; "
                 "retry shortly"
             )
-        return eng.submit(**inputs)
+        return eng.submit(priority=priority, **inputs)
 
     def warm_async(self, **example) -> None:
         with self._lock:

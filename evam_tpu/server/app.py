@@ -29,6 +29,7 @@ from aiohttp import web
 from evam_tpu.config import Settings
 from evam_tpu.models.registry import MissingWeightsError
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.sched import AdmissionError
 from evam_tpu.server.registry import PipelineRegistry, RequestError
 
 log = get_logger("server.app")
@@ -73,6 +74,16 @@ def build_app(
         try:
             instance = await asyncio.to_thread(
                 registry.start_instance, name, version, body
+            )
+        except AdmissionError as exc:
+            # over capacity (evam_tpu/sched/admission.py): the honest
+            # serving answer — 503 + Retry-After, never a silent
+            # oversubscription that degrades the admitted streams
+            return web.json_response(
+                {"error": str(exc),
+                 "retry_after_s": exc.retry_after_s},
+                status=503,
+                headers={"Retry-After": str(int(exc.retry_after_s))},
             )
         except KeyError as exc:
             return _json_error(404, str(exc.args[0]))
@@ -125,6 +136,13 @@ def build_app(
     async def engines(request: web.Request) -> web.Response:
         return web.json_response(registry.hub.stats())
 
+    async def scheduler(request: web.Request) -> web.Response:
+        # QoS layer introspection (evam_tpu/sched/): capacity model,
+        # per-class admission counters, live class-queue depths and
+        # shed totals — stable shape whether EVAM_SCHED is on or off
+        return web.json_response(
+            await asyncio.to_thread(registry.scheduler_status))
+
     async def metrics_endpoint(request: web.Request) -> web.Response:
         return web.Response(text=metrics.render(),
                             content_type="text/plain")
@@ -138,6 +156,20 @@ def build_app(
         # Fixed keys from boot (zeros before any batch): the health
         # payload's shape is part of the golden route contract.
         ready["host_stages_ms"] = registry.hub.stage_summary()
+        # submit-queue backlog (sched satellite): depth + oldest-item
+        # age across engines — the overload signal that used to be
+        # invisible until the stall watchdog tripped. Refreshes the
+        # evam_engine_queue_depth/age gauges on the way.
+        ready["queue"] = registry.hub.queue_summary()
+        # QoS ladder summary (admit → queue → shed): per-class
+        # rejected/shed counts; fixed keys from boot (golden shape)
+        counts = registry.admission.counts()
+        ready["scheduler"] = {
+            "enabled": registry.sched_cfg.enabled,
+            "admitted": counts["admitted"],
+            "rejected": counts["rejected"],
+            "shed": registry.hub.shed_totals(),
+        }
         # shared-ingest visibility: the demux/pool serve EVERY live
         # stream — a monitoring consumer needs their frame counters
         # next to engine readiness
@@ -175,6 +207,7 @@ def build_app(
         web.delete("/pipelines/{name}/{version}/{instance_id}", instance_stop),
         web.get("/models", list_models),
         web.get("/engines", engines),
+        web.get("/scheduler", scheduler),
         web.get("/metrics", metrics_endpoint),
         web.get("/healthz", healthz),
     ])

@@ -74,12 +74,17 @@ class StreamInstance:
         source: Any | None = None,
         decode_pool: Any | None = None,
         rtsp_demux: Any | None = None,
+        priority: str = "standard",
     ):
         self.id = str(uuid.uuid4())
         self.pipeline_name = pipeline_name
         self.version = version
         self.request = request
         self.stages = stages
+        #: QoS class (realtime|standard|batch, evam_tpu/sched/):
+        #: stamped on every frame so the shared engines schedule this
+        #: stream's submits in its class lane
+        self.priority = priority
         self.destination = destination or NullDestination()
         self.frame_sink = frame_sink
         self.max_retries = max_retries
@@ -214,6 +219,7 @@ class StreamInstance:
             stream_id=self.id,
             stages=self.stages,
             source_uri=src_cfg0.get("uri", ""),
+            priority=self.priority,
         )
         src_cfg = src_cfg0
         pooled = None
@@ -270,7 +276,8 @@ class StreamInstance:
                 return
             self._source = stream
         self._runner = StreamRunner(
-            stream_id=self.id, stages=self.stages, source_uri=uri)
+            stream_id=self.id, stages=self.stages, source_uri=uri,
+            priority=self.priority)
         try:
             self._runner.run(stream.frames())
             if stream.error:
@@ -325,6 +332,7 @@ class StreamInstance:
             "avg_fps": round(self.avg_fps, 2),
             "start_time": self.start_time,
             "elapsed_time": round(elapsed, 3),
+            "priority": self.priority,
         }
         if self.error:
             out["message"] = self.error

@@ -23,6 +23,12 @@ from evam_tpu.models.registry import ModelRegistry
 from evam_tpu.obs import get_logger, metrics
 from evam_tpu.parallel.mesh import build_mesh
 from evam_tpu.publish.base import create_destination
+from evam_tpu.sched import (
+    AdmissionController,
+    SchedConfig,
+    validate_priority,
+)
+from evam_tpu.sched.classes import DEFAULT_PRIORITY
 from evam_tpu.server.instance import InstanceState, StreamInstance
 from evam_tpu.stages.build import build_stages
 
@@ -46,6 +52,9 @@ class PipelineRegistry:
                 models_dir=settings.models_dir,
                 dtype=settings.tpu.precision,
             )
+            sched_cfg = SchedConfig.from_settings(
+                settings.sched,
+                standard_deadline_ms=settings.tpu.batch_deadline_ms)
             hub = EngineHub(
                 registry,
                 plan=plan,
@@ -58,8 +67,16 @@ class PipelineRegistry:
                 restart_window_s=settings.tpu.restart_window_s,
                 restart_backoff_s=settings.tpu.restart_backoff_s,
                 first_batch_grace=settings.tpu.first_batch_grace,
+                sched=sched_cfg if sched_cfg.enabled else None,
             )
         self.hub = hub
+        #: QoS layer (evam_tpu/sched/): the hub's sched config is the
+        #: single source of truth — an embedder-supplied hub without
+        #: one (tests, benches) gets a disabled admission controller,
+        #: so the legacy unconditional-admit path stays byte-identical
+        self.sched_cfg = (getattr(hub, "sched", None)
+                          or SchedConfig.disabled())
+        self.admission = AdmissionController(hub, self.sched_cfg)
         #: shared decode pool (opt-in, EVAM_DECODE_POOL_WORKERS>0):
         #: bounds total decode threads across all instances
         self.decode_pool = None
@@ -195,6 +212,50 @@ class PipelineRegistry:
                 raise RequestError("request.source must be an object")
             if "uri" not in src and src.get("type", "uri") == "uri":
                 raise RequestError("request.source.uri is required")
+        # QoS class: request body beats the pipeline spec's default
+        # beats `standard` — validated HERE so a bad value is a 400,
+        # never a silently-standard stream (evam_tpu/sched/).
+        priority = request.get("priority")
+        if priority is None:
+            priority = spec.raw.get("priority", DEFAULT_PRIORITY)
+        try:
+            priority = validate_priority(priority)
+        except ValueError as exc:
+            raise RequestError(str(exc)) from None
+        try:
+            fps = float(request.get("fps") or self.sched_cfg.default_fps)
+        except (TypeError, ValueError):
+            raise RequestError("request.fps must be a number") from None
+        if fps <= 0:
+            raise RequestError("request.fps must be > 0")
+        # Admission BEFORE any resource work: an over-capacity start
+        # must cost nothing and fail fast (503 + Retry-After raised as
+        # AdmissionError to server/app.py). The ticket is the stream's
+        # capacity reservation; release is idempotent and runs from
+        # BOTH the failure unwind and the instance-finish cleanups.
+        ticket = self.admission.admit(priority, fps)
+        try:
+            return self._start_admitted(
+                name, version, spec, src, request, priority, ticket,
+                publish_fn, source, sink_fn, saved_state)
+        except BaseException:
+            ticket.release()
+            raise
+
+    def _start_admitted(
+        self,
+        name: str,
+        version: str,
+        spec,
+        src,
+        request: dict[str, Any],
+        priority: str,
+        ticket,
+        publish_fn,
+        source,
+        sink_fn,
+        saved_state: dict[str, dict] | None,
+    ) -> StreamInstance:
         params = request.get("parameters") or {}
         # Resolve stages BEFORE opening the destination: a bad
         # parameter must not truncate/leak the operator's output file.
@@ -211,11 +272,12 @@ class PipelineRegistry:
             source=source,
             decode_pool=self.decode_pool,
             rtsp_demux=self.rtsp_demux,
+            priority=priority,
         )
         meta_fn = publish_fn or (lambda ctx: destination.publish(ctx.metadata))
         frame_cfg = (request.get("destination") or {}).get("frame") or {}
         relay = None
-        cleanup_fns: list = []
+        cleanup_fns: list = [ticket.release]
         if frame_cfg.get("type") == "rtsp" and self.rtsp is not None:
             # Annotated re-stream at rtsp://host:8554/<path> (reference
             # destination.frame contract + ENABLE_RTSP flow).
@@ -303,6 +365,18 @@ class PipelineRegistry:
         with self._lock:
             instances = list(self.instances.values())
         return [i.status() for i in instances]
+
+    def scheduler_status(self) -> dict[str, Any]:
+        """GET /scheduler payload: the admission snapshot (capacity /
+        demand / utilization / per-class counters) plus the live
+        per-class queue depths and shed totals from the engines. Keys
+        are fixed from boot regardless of EVAM_SCHED — the route is a
+        golden contract."""
+        out = self.admission.snapshot()
+        out["shed"] = self.hub.shed_totals()
+        out["queues"] = self.hub.class_queue_depths()
+        out["queue"] = self.hub.queue_summary()
+        return out
 
     def stop_all(self) -> int:
         """Drain every instance and shut the engines down. Returns the

@@ -81,6 +81,10 @@ class FrameContext:
     stage_index: int = 0
     #: wall-clock ingest time (perf_counter) for latency histograms
     ingest_t: float | None = None
+    #: QoS class of the owning stream (realtime|standard|batch) —
+    #: engine-backed stages pass it to BatchEngine.submit so the
+    #: shared engines schedule per class (evam_tpu/sched/)
+    priority: str = "standard"
     #: arbitrary cross-stage scratch (e.g. pending futures)
     scratch: dict[str, Any] = field(default_factory=dict)
 

@@ -152,6 +152,7 @@ class DetectStage(AsyncStage):
         if (self._count - 1) % self.interval:
             return None  # inference-interval skip: reuse last regions
         return self.engine.submit(
+            priority=ctx.priority,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
@@ -239,6 +240,7 @@ class ClassifyStage(AsyncStage):
         for i, r in enumerate(regions):
             boxes[i] = [r.x0, r.y0, r.x1, r.y1]
         return self.engine.submit(
+            priority=ctx.priority,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire),
             boxes=boxes)
 
@@ -311,7 +313,9 @@ class ActionStage(AsyncStage):
         flowing while a decoder batch is pending, and the action
         pipeline runs at encoder throughput.
         """
+        prio = ctx.priority
         enc_fut = self.enc_engine.submit(
+            priority=prio,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
         outer: Future = Future()
 
@@ -330,7 +334,7 @@ class ActionStage(AsyncStage):
                     return
                 clip = np.stack(self.clip)  # [T, D]
                 # raises RuntimeError when the engine is stopping
-                dec_fut = self.dec_engine.submit(clips=clip)
+                dec_fut = self.dec_engine.submit(priority=prio, clips=clip)
             except Exception as exc:  # noqa: BLE001 — propagate to the runner
                 outer.set_exception(exc)
                 return
@@ -397,7 +401,8 @@ class AudioDetectStage(AsyncStage):
         if len(self._buffer) < self.WINDOW or self._since_last < self.stride:
             return None
         self._since_last = 0
-        return self.engine.submit(windows=self._buffer.copy())
+        return self.engine.submit(priority=ctx.priority,
+                                  windows=self._buffer.copy())
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
@@ -479,6 +484,7 @@ class FusedDetectClassifyStage(AsyncStage):
         if (self._count - 1) % self.interval:
             return None
         return self.engine.submit(
+            priority=ctx.priority,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:

@@ -44,10 +44,13 @@ class StreamRunner:
         source_uri: str = "",
         window: int = 4,
         on_error: Callable[[Exception], None] | None = None,
+        priority: str = "standard",
     ):
         self.stream_id = stream_id
         self.stages = stages
         self.source_uri = source_uri
+        #: QoS class stamped on every FrameContext (evam_tpu/sched/)
+        self.priority = priority
         self.window = max(1, window)
         self.on_error = on_error
         self.frames_in = 0
@@ -80,6 +83,7 @@ class StreamRunner:
             stream_id=self.stream_id,
             source_uri=self.source_uri,
             ingest_t=time.perf_counter(),
+            priority=self.priority,
         )
         if self._faults is not None:
             try:
@@ -161,7 +165,8 @@ class StreamRunner:
         metrics.inc("evam_frames_processed", labels={"stream": self.stream_id})
         if ctx.ingest_t is not None:
             observe_frame_latency(
-                self.stream_id, time.perf_counter() - ctx.ingest_t)
+                self.stream_id, time.perf_counter() - ctx.ingest_t,
+                priority=ctx.priority)
 
     def _handle_error(self, exc: Exception, ctx: FrameContext) -> None:
         self.errors += 1

@@ -73,6 +73,12 @@ def test_bench_serialize_compile_serve_emits_contract_line():
     # (ringbuf.STAGES) next to the throughput number
     assert {"slot_write", "launch", "readback"} \
         <= set(data["host_stage_p50_ms"])
+    # QoS-layer outcome rides the line per class (evam_tpu/sched/):
+    # both bench streams admit as `standard`, nothing rejected/shed
+    for key in ("sched_admitted", "sched_rejected", "sched_shed"):
+        assert set(data[key]) == {"realtime", "standard", "batch"}, key
+    assert data["sched_admitted"]["standard"] == 2
+    assert sum(data["sched_rejected"].values()) == 0
 
 
 def test_bench_hostpath_slot_not_slower_than_legacy():

@@ -98,6 +98,14 @@ def registry(eight_devices, tmp_path_factory):
 
 
 def _request(registry, method, path, body=None):
+    status, data, _ = _request_h(registry, method, path, body)
+    return status, data
+
+
+def _request_h(registry, method, path, body=None):
+    """Like _request but also returns the response headers (the
+    admission contract pins a Retry-After header, not just a body)."""
+
     async def go():
         app = build_app(registry)
         async with TestClient(TestServer(app)) as client:
@@ -106,7 +114,7 @@ def _request(registry, method, path, body=None):
                 data = await resp.json()
             except Exception:
                 data = await resp.text()
-            return resp.status, data
+            return resp.status, data, dict(resp.headers)
 
     return asyncio.run(go())
 
@@ -198,6 +206,67 @@ class TestRestRouteContracts:
             "/pipelines/object_detection/person_vehicle_bike/no-such-id/status")
         assert status == 404
         check_golden("route_404_instance", data)
+
+
+class TestSchedulerContracts:
+    """QoS-layer REST contracts (evam_tpu/sched/): over-capacity 503
+    + Retry-After, 400 on a bad priority, and the /scheduler payload
+    shape."""
+
+    @pytest.fixture(scope="class")
+    def sched_registry(self, eight_devices):
+        """Registry whose hub runs the QoS layer with a deliberately
+        tiny declared capacity: every 30 fps start projects util 3.0
+        and is rejected — the deterministic over-capacity shape."""
+        from evam_tpu.sched import SchedConfig
+
+        settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+        model_registry = ModelRegistry(
+            dtype="float32", input_overrides=SMALL,
+            width_overrides=NARROW)
+        hub = EngineHub(model_registry, plan=build_mesh(), max_batch=16,
+                        deadline_ms=4.0,
+                        sched=SchedConfig(capacity_fps=10.0))
+        reg = PipelineRegistry(settings, hub=hub)
+        yield reg
+        reg.stop_all()
+
+    def test_over_capacity_start_rejected_503(self, sched_registry):
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=6",
+                       "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+            "priority": "batch",
+        }
+        status, data, headers = _request_h(
+            sched_registry, "POST",
+            "/pipelines/object_detection/person_vehicle_bike", body)
+        assert status == 503
+        assert "Retry-After" in headers
+        assert int(headers["Retry-After"]) >= 1
+        check_golden("route_503_admission", data)
+        # ... and the rejection is class-attributed on /scheduler
+        status, sched = _request(sched_registry, "GET", "/scheduler")
+        assert status == 200
+        assert sched["rejected"]["batch"] >= 1
+
+    def test_unknown_priority_is_400(self, registry):
+        body = {
+            "source": {"uri": "synthetic://96x96@30?count=6",
+                       "type": "uri"},
+            "destination": {"metadata": {"type": "null"}},
+            "priority": "turbo",
+        }
+        status, data = _request(
+            registry, "POST",
+            "/pipelines/object_detection/person_vehicle_bike", body)
+        assert status == 400
+        check_golden("route_400_bad_priority", data)
+
+    def test_scheduler_payload_shape(self, registry):
+        status, data = _request(registry, "GET", "/scheduler")
+        assert status == 200
+        check_golden("route_scheduler", data)
 
 
 class TestPublishedMetadataContracts:
