@@ -66,6 +66,13 @@ class TPUSettings(BaseModel):
     #: cold start — including a supervisor rebuild's fresh jit —
     #: reads as a wedge
     first_batch_grace: float = 10.0
+    #: device-transfer pipeline (engine/batcher.py): "pipelined"
+    #: (default) overlaps the H2D upload of batch N+1 with batch N's
+    #: launch on a dedicated launcher thread and issues D2H copies
+    #: asynchronously at launch; "inline" is the serial pre-pipeline
+    #: path, kept byte-identical for A/B (tools/bench_transfer.py).
+    #: EVAM_SERIALIZE_COMPILE=1 forces inline regardless.
+    transfer: Literal["pipelined", "inline"] = "pipelined"
 
 
 class SchedSettings(BaseModel):
@@ -188,6 +195,7 @@ class Settings(BaseModel):
             "EVAM_ENGINE_RESTART_WINDOW_S": ("restart_window_s", float),
             "EVAM_ENGINE_RESTART_BACKOFF_S": ("restart_backoff_s", float),
             "EVAM_FIRST_BATCH_GRACE": ("first_batch_grace", float),
+            "EVAM_TRANSFER": ("transfer", str),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

@@ -50,6 +50,7 @@ class EngineHub:
         restart_backoff_s: float = 0.5,
         first_batch_grace: float = 10.0,
         sched: SchedConfig | None = None,
+        transfer: str | None = None,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -84,6 +85,13 @@ class EngineHub:
         #: the class queues because the factory closure carries it.
         #: None = the legacy single-FIFO engines (EVAM_SCHED=off).
         self.sched = sched if (sched is not None and sched.enabled) else None
+        #: device-transfer pipeline (EVAM_TRANSFER): "pipelined"
+        #: (default) overlaps H2D upload / launch / async D2H inside
+        #: every engine; "inline" is the serial pre-pipeline path
+        #: (A/B, tools/bench_transfer.py). Part of the rebuild recipe:
+        #: the factory closure carries it, so a supervisor-rebuilt
+        #: engine keeps its transfer mode. None = engine reads the env.
+        self.transfer = transfer
         self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
@@ -185,6 +193,7 @@ class EngineHub:
                 stall_timeout_s=self.stall_timeout_s,
                 first_batch_grace=self.first_batch_grace,
                 sched=self.sched,
+                transfer=self.transfer,
             )
 
         if not self.supervise:
@@ -234,6 +243,11 @@ class EngineHub:
                     "mean_occupancy": e.stats.mean_occupancy,
                     "warmed": e.warmed.is_set(),
                     "assembly": e.assembly,
+                    # effective device-transfer mode (EVAM_TRANSFER;
+                    # devlock may have forced a pipelined request to
+                    # inline — report what actually runs)
+                    "transfer": ("pipelined" if getattr(
+                        e, "_pipelined", False) else "inline"),
                     # per-batch host clock means (ringbuf.STAGES order)
                     "stage_ms": e.stats.stage_ms_per_batch(),
                     # supervision lifecycle (engine/supervisor.py);
@@ -255,8 +269,9 @@ class EngineHub:
     def stage_summary(self) -> dict[str, float]:
         """Batch-weighted mean per-batch host-stage cost across ALL
         engines (ms) — the /healthz attribution block: where a
-        batch's wall time goes (slot-write vs device_put vs launch vs
-        readback) without scraping /metrics quantiles. Keys are fixed
+        batch's wall time goes (slot-write vs h2d issue/wait vs launch
+        vs readback residual) without scraping /metrics quantiles.
+        Keys are fixed
         (ringbuf.STAGES) from boot so the health payload keeps a
         stable shape; per-engine detail lives on /engines."""
         from evam_tpu.engine.ringbuf import STAGES

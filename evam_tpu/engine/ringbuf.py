@@ -53,10 +53,17 @@ import numpy as np
 #: stage names of the per-batch host clock, in pipeline order.
 #: submit_wait covers slot backpressure AND the deadline-batching
 #: formation wait; slot_write is the summed per-item row copies
-#: (spent on stream threads, overlapped across submitters).
+#: (spent on stream threads, overlapped across submitters). The
+#: device boundary is split transfer-honestly (EVAM_TRANSFER):
+#: h2d_issue is the time for device_put to ENQUEUE the host→device
+#: copy, h2d_wait the residual wait for that copy at launch (≈0 when
+#: the pipelined uploader overlapped it with the previous launch; 0
+#: by definition on the inline path, where the launch itself absorbs
+#: it), and readback the device→host residual the completer still
+#: has to block on after the async D2H copy was put in flight.
 STAGES = (
     "submit_wait", "slot_write", "seal",
-    "device_put", "launch", "readback", "resolve",
+    "h2d_issue", "h2d_wait", "launch", "readback", "resolve",
 )
 
 

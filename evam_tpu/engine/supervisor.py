@@ -172,6 +172,8 @@ class SupervisedEngine:
             return "dispatcher thread died"
         if not eng._completer.is_alive():
             return "completion thread died"
+        if eng._launcher is not None and not eng._launcher.is_alive():
+            return "transfer launcher thread died"
         return None
 
     def _monitor_loop(self) -> None:
@@ -180,6 +182,12 @@ class SupervisedEngine:
                 if self.state == "degraded":
                     return
                 eng = self._engine
+            # keep the backlog gauges live even while the engine is
+            # wedged/idle (they otherwise refresh only on dispatch)
+            try:
+                eng.refresh_queue_gauges()
+            except Exception:  # noqa: BLE001 — engine mid-teardown
+                pass
             reason = self._wedged(eng)
             if reason is not None:
                 self._quarantine_and_rebuild(eng, reason)

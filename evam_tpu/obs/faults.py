@@ -145,18 +145,41 @@ class FaultInjector:
 
 
 _cache: tuple[tuple[str, str], FaultInjector | None] | None = None
+#: process-wide memo for the hot path: a 1-tuple holding the resolved
+#: injector (or None). ``current()`` reads it without touching the
+#: environment — BatchEngine consults per BATCH, and two getenv calls
+#: plus a tuple compare per batch is real dispatcher-thread work at
+#: the serving rate. Cleared by ``reset_cache()`` (the explicit
+#: reconfiguration hook) and refreshed by any ``from_env()`` call.
+_resolved: tuple[FaultInjector | None] | None = None
+
+
+def current() -> FaultInjector | None:
+    """Hot-path accessor: the memoized injector, no env reads.
+
+    Resolution happens once — the first call after import or after
+    ``reset_cache()`` pays the env read + parse (via ``from_env``);
+    every later call is one global load. Code that changes
+    ``EVAM_FAULT_INJECT``/``EVAM_FAULT_SEED`` at runtime
+    (tests/test_chaos.py, tools/chaos_soak.py) must call
+    ``reset_cache()`` for engines to observe the new spec."""
+    if _resolved is not None:
+        return _resolved[0]
+    return from_env()
 
 
 def from_env() -> FaultInjector | None:
     """Injector for the current EVAM_FAULT_INJECT value, parsed (and
     its ACTIVE warning logged) once per distinct (spec, seed) — runners
     are created per stream and per reconnect attempt, and the engines
-    consult per batch; they all share one injector so wedge_n and the
-    seeded RNG stream are global."""
-    global _cache
+    consult per batch (through the memoized ``current()``); they all
+    share one injector so wedge_n and the seeded RNG stream are
+    global."""
+    global _cache, _resolved
     spec = os.environ.get("EVAM_FAULT_INJECT", "")
     seed_str = os.environ.get("EVAM_FAULT_SEED", "")
     if _cache is not None and _cache[0] == (spec, seed_str):
+        _resolved = (_cache[1],)
         return _cache[1]
     seed: int | None = None
     if seed_str:
@@ -171,11 +194,14 @@ def from_env() -> FaultInjector | None:
         log.warning("fault injection ACTIVE: %s%s", spec,
                     f" (seed={seed})" if seed is not None else "")
     _cache = ((spec, seed_str), result)
+    _resolved = (result,)
     return result
 
 
 def reset_cache() -> None:
-    """Drop the cached injector (tests: a fresh spec must re-parse and
-    a reused spec must restart its wedge_n countdown)."""
-    global _cache
+    """Drop the cached injector (tests: a fresh spec must re-parse, a
+    reused spec must restart its wedge_n countdown, and the engines'
+    memoized ``current()`` view must re-resolve)."""
+    global _cache, _resolved
     _cache = None
+    _resolved = None

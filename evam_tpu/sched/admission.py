@@ -14,8 +14,8 @@ mechanism split, PAPERS.md) and is driven by observed engine timings:
 
     capacity_fps = min over engines of
         batches/s (1 / per-batch device-path seconds, from the PR-1
-        stage clock: device_put + launch + readback) x mean occupancy
-        x top bucket
+        stage clock: h2d issue + wait + launch + readback residual)
+        x mean occupancy x top bucket
 
 i.e. "what the slowest shared engine delivers if every batch were as
 full as the measured mix". Operators can pin it instead with
@@ -45,8 +45,11 @@ log = get_logger("sched.admission")
 CLASS_HEADROOM = {"realtime": 1.0, "standard": 0.85, "batch": 0.6}
 
 #: device-path stages of the per-batch clock (engine/ringbuf.STAGES)
-#: that bound the serial service time of one batch
-_SERVICE_STAGES = ("device_put", "launch", "readback")
+#: that bound the serial service time of one batch. With the
+#: pipelined transfer h2d_wait and readback are residuals — honest
+#: inputs here: overlapped copy time must not be double-counted
+#: against capacity.
+_SERVICE_STAGES = ("h2d_issue", "h2d_wait", "launch", "readback")
 
 
 class AdmissionError(RuntimeError):

@@ -68,6 +68,7 @@ class PipelineRegistry:
                 restart_backoff_s=settings.tpu.restart_backoff_s,
                 first_batch_grace=settings.tpu.first_batch_grace,
                 sched=sched_cfg if sched_cfg.enabled else None,
+                transfer=settings.tpu.transfer,
             )
         self.hub = hub
         #: QoS layer (evam_tpu/sched/): the hub's sched config is the

@@ -60,6 +60,19 @@ def pytest_collection_modifyitems(config, items):
         assert not stale, f"SLOW_MODULES entries match no test file: {stale}"
 
 
+@pytest.fixture(autouse=True)
+def _reset_fault_memo():
+    """The fault injector is memoized process-wide (obs/faults.py —
+    the engine consults it per batch, so the hot path must not re-read
+    the environment). Tests that monkeypatch EVAM_FAULT_INJECT rely on
+    teardown restoring the env; restore the memo with it so a stale
+    injector never leaks into the next test's engines."""
+    yield
+    from evam_tpu.obs import faults
+
+    faults.reset_cache()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

@@ -36,7 +36,7 @@ def _assert_contract(r):
 def test_bench_healthy_cpu_run_emits_contract_line():
     r = _run_bench(
         ["--config", "audio", "--seconds", "2", "--batch", "4",
-         "--depth", "2"],
+         "--depth", "2", "--ingest", "host"],
         {"BENCH_PLATFORM": "cpu"},
     )
     assert r.returncode == 0, r.stderr[-1500:]
@@ -46,10 +46,13 @@ def test_bench_healthy_cpu_run_emits_contract_line():
     assert data["metric"] == "audio_streams_per_chip"
     assert data["value"] > 0
     assert {"batch", "depth", "p50_ms", "p99_ms"} <= set(data)
-    # host-latency attribution rides the contract line (launch
-    # dispatch + readback wait; device_put appears under --ingest
-    # host) without changing the metric's definition
-    assert {"launch", "readback"} <= set(data["host_stage_p50_ms"])
+    # host-latency attribution rides the contract line: the raw
+    # --ingest host loop reports the transfer-honest split (h2d_issue
+    # = device_put enqueue, h2d_wait = the copy's blocking residual)
+    # next to launch dispatch + readback wait, matching the engine
+    # stage clock (ringbuf.STAGES)
+    assert {"h2d_issue", "h2d_wait", "launch", "readback"} \
+        <= set(data["host_stage_p50_ms"])
 
 
 def test_bench_serialize_compile_serve_emits_contract_line():
@@ -70,9 +73,11 @@ def test_bench_serialize_compile_serve_emits_contract_line():
     assert data["errors"] == 0
     assert data["dead_streams"] == 0
     # the serve line attributes host latency by engine stage
-    # (ringbuf.STAGES) next to the throughput number
-    assert {"slot_write", "launch", "readback"} \
-        <= set(data["host_stage_p50_ms"])
+    # (ringbuf.STAGES) next to the throughput number, including the
+    # transfer-pipeline split (h2d_wait is recorded even here, where
+    # --serialize-compile forces the inline path and pins it at 0)
+    assert {"slot_write", "h2d_issue", "h2d_wait", "launch",
+            "readback"} <= set(data["host_stage_p50_ms"])
     # QoS-layer outcome rides the line per class (evam_tpu/sched/):
     # both bench streams admit as `standard`, nothing rejected/shed
     for key in ("sched_admitted", "sched_rejected", "sched_shed"):

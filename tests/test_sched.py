@@ -267,17 +267,17 @@ class TestAdmission:
     def test_capacity_derived_from_engine_stats(self):
         """capacity = batches/s x mean occupancy x top bucket of the
         BOTTLENECK engine (per-batch device path from the PR-1 stage
-        clock: device_put + launch + readback)."""
+        clock: h2d issue/wait + launch + readback residual)."""
         stats = {
             "detect:m": {  # 10ms/batch, occ 0.5 -> 100*0.5*16 = 800
                 "batches": 10, "mean_occupancy": 0.5,
-                "stage_ms": {"device_put": 2.0, "launch": 6.0,
-                             "readback": 2.0},
+                "stage_ms": {"h2d_issue": 1.0, "h2d_wait": 1.0,
+                             "launch": 6.0, "readback": 2.0},
             },
             "classify:m": {  # 40ms/batch, occ 1.0 -> 25*1.0*16 = 400
                 "batches": 5, "mean_occupancy": 1.0,
-                "stage_ms": {"device_put": 10.0, "launch": 20.0,
-                             "readback": 10.0},
+                "stage_ms": {"h2d_issue": 8.0, "h2d_wait": 2.0,
+                             "launch": 20.0, "readback": 10.0},
             },
             "cold:m": {"batches": 0, "mean_occupancy": 0.0,
                        "stage_ms": {}},
@@ -324,7 +324,12 @@ class TestEngineSched:
         in evam_sched_shed_total{class}."""
         cfg = SchedConfig(staleness_ms={
             "realtime": 10_000.0, "standard": 10_000.0, "batch": 40.0})
-        eng = _toy_engine("sched-flood", sched=cfg)
+        # inline transfer: the gate patches the serial device call, so
+        # the DISPATCHER must be the thread that blocks on it — with
+        # the pipelined transfer the dispatcher would keep draining
+        # the class queues into the upload pipeline and the backlog
+        # this test asserts on would live there instead
+        eng = _toy_engine("sched-flood", sched=cfg, transfer="inline")
         gate = threading.Event()
         entered = threading.Event()
         orig_run = eng._run
@@ -413,7 +418,9 @@ class TestEngineSched:
 
     def test_stop_fails_queued_items(self):
         cfg = SchedConfig()
-        eng = _toy_engine("sched-stop", sched=cfg)
+        # inline: the gate must block the dispatcher (see the flood
+        # test) so the stuck submits stay queued until stop()
+        eng = _toy_engine("sched-stop", sched=cfg, transfer="inline")
         gate = threading.Event()
         entered = threading.Event()
         orig_run = eng._run
@@ -498,6 +505,7 @@ class TestSettingsPlumbing:
         from evam_tpu.server.registry import PipelineRegistry
 
         monkeypatch.setenv("EVAM_BATCH_DEADLINE_MS", "11.5")
+        monkeypatch.setenv("EVAM_TRANSFER", "inline")
         monkeypatch.setenv("EVAM_SCHED", "on")
         monkeypatch.setenv("EVAM_SCHED_ADMIT_UTIL", "0.7")
         monkeypatch.setenv("EVAM_SCHED_DEADLINE_MS_BATCH", "40")
@@ -506,9 +514,13 @@ class TestSettingsPlumbing:
         settings = settings.model_copy(
             update={"pipelines_dir": str(REPO / "pipelines")})
         assert settings.tpu.batch_deadline_ms == 11.5
+        assert settings.tpu.transfer == "inline"
         reg = PipelineRegistry(settings)
         try:
             assert reg.hub.deadline_ms == 11.5
+            # EVAM_TRANSFER reaches the hub (and through its factory,
+            # every engine and every supervisor rebuild)
+            assert reg.hub.transfer == "inline"
             assert reg.hub.sched is not None
             assert reg.hub.sched.admit_util == 0.7
             assert reg.hub.sched.deadline_ms["batch"] == 40.0
