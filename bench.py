@@ -402,6 +402,13 @@ def run_serve_bench(args) -> dict:
         # overload ladder.
         sched_counts = reg.admission.counts()
         sched_shed = reg.hub.shed_totals()
+        # content-adaptive gating outcome (stages/gate.py): run/skip
+        # totals across gated streams, reset-proof like the sched
+        # counters. All-zero = the run never gated (EVAM_GATE off and
+        # no adaptive inference-interval) — the ungated A/B baseline.
+        from evam_tpu.stages.gate import registry as gate_registry
+
+        gate_summary = gate_registry.summary()
         demux_stats = (reg.rtsp_demux.stats()
                        if reg.rtsp_demux is not None else None)
     finally:
@@ -443,6 +450,7 @@ def run_serve_bench(args) -> dict:
         "sched_admitted": sched_counts["admitted"],
         "sched_rejected": sched_counts["rejected"],
         "sched_shed": sched_shed,
+        "gate": gate_summary,
         **({"demux": demux_stats} if demux_stats else {}),
     }
 

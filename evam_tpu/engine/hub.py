@@ -320,10 +320,12 @@ class EngineHub:
         return out
 
     def shed_totals(self) -> dict[str, int]:
-        """Summed per-class shed counts across live engines. NOTE: a
-        supervisor rebuild resets its engine's local counts; the
-        monotonic series is evam_sched_shed_total{class} in /metrics
-        — this is the live-engine view for /healthz and the bench."""
+        """Summed per-class shed counts across engines. Monotonic
+        across supervisor rebuilds: SupervisedEngine.shed_counts folds
+        in the counts absorbed from quarantined predecessors
+        (supervisor._absorb_counters), so this matches the
+        evam_sched_shed_total{class} series instead of silently
+        resetting when an engine is rebuilt."""
         out = {c: 0 for c in PRIORITIES}
         with self._lock:
             engines = list(self._engines.values())

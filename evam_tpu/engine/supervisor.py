@@ -46,7 +46,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable
 
-from evam_tpu.engine.batcher import BatchEngine
+from evam_tpu.engine.batcher import BatchEngine, EngineStats
 from evam_tpu.obs import get_logger, metrics
 
 log = get_logger("engine.supervisor")
@@ -86,6 +86,12 @@ class SupervisedEngine:
         self.restarts = 0
         self.last_stall_ts: float | None = None
         self._restart_times: deque[float] = deque()
+        #: cumulative counters folded in from quarantined engines
+        #: (_absorb_counters): a rebuild swaps in a fresh BatchEngine
+        #: with zeroed local counts, and /healthz, /engines and the
+        #: bench contract line must stay MONOTONIC across it
+        self._shed_carry: dict[str, int] = {}
+        self._stats_carry: EngineStats | None = None
         self._example: dict | None = None
         self._warm_requested = False
         self._lock = threading.RLock()
@@ -148,6 +154,62 @@ class SupervisedEngine:
             eng.abandon()
         self._monitor.join(timeout=5)
 
+    # --------------------------------------- cumulative counter carry
+
+    @property
+    def stats(self) -> EngineStats:
+        """Cumulative EngineStats: the live engine's counts plus
+        everything absorbed from quarantined predecessors. With no
+        restarts this is the live object itself (zero overhead); after
+        a rebuild it is a merged read-only snapshot."""
+        live = object.__getattribute__(self, "_engine").stats
+        with self._lock:
+            carry = self._stats_carry
+            if carry is None:
+                return live
+            merged = EngineStats(
+                batches=carry.batches + live.batches,
+                items=carry.items + live.items,
+                occupancy_sum=carry.occupancy_sum + live.occupancy_sum,
+                stage_seconds=dict(carry.stage_seconds),
+            )
+        for k, v in live.stage_seconds.items():
+            merged.stage_seconds[k] = merged.stage_seconds.get(k, 0.0) + v
+        return merged
+
+    def shed_counts(self) -> dict[str, int]:
+        """Per-class shed totals including quarantined predecessors —
+        keeps hub.shed_totals() (and with it /healthz and the bench
+        line) monotonic across supervisor rebuilds."""
+        live = object.__getattribute__(self, "_engine").shed_counts()
+        with self._lock:
+            if not self._shed_carry:
+                return live
+            out = dict(self._shed_carry)
+        for c, n in live.items():
+            out[c] = out.get(c, 0) + n
+        return out
+
+    def _absorb_counters(self, eng: BatchEngine) -> None:
+        """Fold a quarantined engine's cumulative counters into the
+        carry BEFORE it is abandoned and replaced."""
+        try:
+            shed = eng.shed_counts()
+            live = eng.stats
+        except Exception:  # noqa: BLE001 — engine mid-teardown
+            return
+        with self._lock:
+            for c, n in shed.items():
+                self._shed_carry[c] = self._shed_carry.get(c, 0) + n
+            if self._stats_carry is None:
+                self._stats_carry = EngineStats()
+            sc = self._stats_carry
+            sc.batches += live.batches
+            sc.items += live.items
+            sc.occupancy_sum += live.occupancy_sum
+            for k, v in live.stage_seconds.items():
+                sc.stage_seconds[k] = sc.stage_seconds.get(k, 0.0) + v
+
     # ------------------------------------------------------ delegation
 
     def __getattr__(self, item):
@@ -195,6 +257,7 @@ class SupervisedEngine:
     def _quarantine_and_rebuild(self, eng: BatchEngine, reason: str) -> None:
         self.last_stall_ts = time.time()
         log.error("engine %s wedged (%s); quarantining", self.name, reason)
+        self._absorb_counters(eng)
         eng.abandon()
         while not self._stop_evt.is_set():
             now = time.time()

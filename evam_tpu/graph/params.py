@@ -44,10 +44,16 @@ def _check_type(name: str, value: Any, schema: dict[str, Any]) -> None:
     expected = schema.get("type")
     if expected is None:
         return
-    types = _JSON_TYPES.get(expected)
-    if types is None:
+    # JSON Schema union, e.g. ["integer", "string"] — used by
+    # inference-interval, which takes an int or the "adaptive" mode
+    names = expected if isinstance(expected, list) else [expected]
+    types: tuple[type, ...] = ()
+    for n in names:
+        types += _JSON_TYPES.get(n, ())
+    if not types:
         return
-    if expected in ("integer", "number") and isinstance(value, bool):
+    if ({"integer", "number"} & set(names) and "boolean" not in names
+            and isinstance(value, bool)):
         raise ParameterError(f"parameter '{name}': expected {expected}, got bool")
     if not isinstance(value, types):
         raise ParameterError(

@@ -56,6 +56,16 @@ def _load() -> ctypes.CDLL | None:
                     _U8P, ctypes.c_int, ctypes.c_int,
                 ]
                 lib.evam_native_version.restype = ctypes.c_int
+                # v2 symbol (motion gate); a stale v1 .so still loads —
+                # luma_grid then takes the numpy fallback
+                try:
+                    lib.luma_grid.argtypes = [
+                        _U8P, ctypes.c_int, ctypes.c_int,
+                        _U8P, ctypes.c_int, ctypes.c_int,
+                    ]
+                    lib._evam_has_luma_grid = True
+                except AttributeError:
+                    lib._evam_has_luma_grid = False
                 _lib = lib
                 log.info("native media kernels loaded (%s, v%d)",
                          p, lib.evam_native_version())
@@ -132,6 +142,40 @@ def bgr_to_i420(frame: np.ndarray) -> np.ndarray:
     import cv2
 
     return cv2.cvtColor(frame, cv2.COLOR_BGR2YUV_I420)
+
+
+#: sample points per grid-cell edge (lattice shared with the C++
+#: kernel — both paths sample the identical pixel coordinates)
+_LUMA_SAMPLES = 4
+
+
+def luma_grid(frame: np.ndarray, gh: int = 16, gw: int = 16) -> np.ndarray:
+    """Downsampled BT.601 luma grid (uint8 [gh, gw]) for the motion
+    gate (stages/gate.py): O(gh*gw*16) point samples regardless of
+    frame resolution, so the per-frame gate cost is negligible next to
+    one engine round-trip. The numpy fallback replays the native
+    kernel's exact sample lattice and integer math — gate decisions
+    are bit-identical with or without the shared library."""
+    if _use_native() and frame.flags.c_contiguous:
+        lib = _load()
+        if getattr(lib, "_evam_has_luma_grid", False):
+            h, w = frame.shape[:2]
+            out = np.empty((gh, gw), np.uint8)
+            lib.luma_grid(_ptr(frame), h, w, _ptr(out), gh, gw)
+            return out
+    h, w = frame.shape[:2]
+    s = _LUMA_SAMPLES
+    n, m = gh * s, gw * s
+    ys = ((2 * np.arange(n, dtype=np.int64) + 1) * h) // (2 * n)
+    xs = ((2 * np.arange(m, dtype=np.int64) + 1) * w) // (2 * m)
+    px = frame[np.ix_(ys, xs)].astype(np.int32)  # [n, m, 3] BGR
+    luma = ((66 * px[..., 2] + 129 * px[..., 1] + 25 * px[..., 0] + 128)
+            >> 8) + 16
+    luma = np.clip(luma, 0, 255)
+    return (
+        luma.reshape(gh, s, gw, s).transpose(0, 2, 1, 3)
+        .reshape(gh, gw, s * s).sum(axis=2) // (s * s)
+    ).astype(np.uint8)
 
 
 def resize_bgr(frame: np.ndarray, dh: int, dw: int) -> np.ndarray:

@@ -120,7 +120,7 @@ class AdmissionController:
         if enforcing:
             cap = self.capacity_fps()
             if cap > 0:
-                util = (self.demand_fps() + fps) / cap
+                util = (self.effective_demand_fps() + fps) / cap
                 ceiling = self.cfg.admit_util * CLASS_HEADROOM.get(
                     priority, 1.0)
                 if util > ceiling:
@@ -132,8 +132,8 @@ class AdmissionController:
                     log.warning(
                         "rejected %s-class start (%.0f fps): projected "
                         "util %.2f > ceiling %.2f (capacity %.0f fps, "
-                        "demand %.0f fps)", priority, fps, util, ceiling,
-                        cap, self.demand_fps(),
+                        "post-gate demand %.0f fps)", priority, fps, util,
+                        ceiling, cap, self.effective_demand_fps(),
                     )
                     raise AdmissionError(priority, util, ceiling,
                                          retry_after)
@@ -154,6 +154,18 @@ class AdmissionController:
         with self._lock:
             return sum(fps for _, fps in self._streams.values())
 
+    def effective_demand_fps(self) -> float:
+        """Declared demand minus the motion gate's recent
+        skipped-frames/s (stages/gate.py registry): frames the gate is
+        provably not submitting don't consume engine capacity, so
+        admission headroom grows while scenes are static. The credit
+        is a live windowed rate — when a static scene starts moving,
+        it decays within the rate window and utilization climbs back
+        toward the declared projection."""
+        from evam_tpu.stages.gate import registry as gate_registry
+
+        return max(0.0, self.demand_fps() - gate_registry.skipped_fps())
+
     def capacity_fps(self) -> float:
         """Declared capacity, or the bottleneck-engine projection from
         live stats; 0 = unknown (cold hub — admit)."""
@@ -173,7 +185,7 @@ class AdmissionController:
 
     def utilization(self) -> float:
         cap = self.capacity_fps()
-        return self.demand_fps() / cap if cap > 0 else 0.0
+        return self.effective_demand_fps() / cap if cap > 0 else 0.0
 
     @staticmethod
     def _retry_after_s(util: float, ceiling: float) -> float:
@@ -207,6 +219,9 @@ class AdmissionController:
             "admit_util": self.cfg.admit_util,
             "capacity_fps": round(self.capacity_fps(), 1),
             "demand_fps": round(self.demand_fps(), 1),
+            # post-gate view (stages/gate.py): what the engines
+            # actually see after motion-gated skips
+            "effective_demand_fps": round(self.effective_demand_fps(), 1),
             "utilization": round(self.utilization(), 3),
             "streams": self.streams_by_class(),
             "admitted": counts["admitted"],

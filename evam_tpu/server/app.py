@@ -170,6 +170,12 @@ def build_app(
             "rejected": counts["rejected"],
             "shed": registry.hub.shed_totals(),
         }
+        # content-adaptive gating (stages/gate.py): aggregate run/skip
+        # totals + live skipped-frames/s across gated streams. Fixed
+        # keys from boot (all-zero when nothing gates) — golden shape.
+        from evam_tpu.stages.gate import registry as gate_registry
+
+        ready["gate"] = gate_registry.summary()
         # shared-ingest visibility: the demux/pool serve EVERY live
         # stream — a monitoring consumer needs their frame counters
         # next to engine readiness

@@ -339,6 +339,16 @@ class StreamInstance:
         weights = self._weight_provenance()
         if weights:
             out["weights"] = weights
+        # per-stream motion-gate state (stages/gate.py): present only
+        # when a stage actually gates, so ungated deployments keep the
+        # reference-shaped payload byte-for-byte
+        gates = {
+            stage.name: stage.gate.snapshot()
+            for stage in self.stages
+            if getattr(stage, "gate", None) is not None
+        }
+        if gates:
+            out["gate"] = gates
         return out
 
     def _weight_provenance(self) -> dict[str, Any]:

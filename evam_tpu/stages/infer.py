@@ -13,7 +13,6 @@ parameters (the engine's in-jit NMS uses a permissive floor).
 
 from __future__ import annotations
 
-import copy
 import itertools
 from collections import deque
 from concurrent.futures import Future
@@ -25,6 +24,8 @@ from evam_tpu.models.zoo.action import CLIP_LEN
 from evam_tpu.obs import get_logger
 from evam_tpu.stages.base import AsyncStage
 from evam_tpu.stages.context import FrameContext, Region, Tensor
+from evam_tpu.stages.gate import maybe_gate
+from evam_tpu.stages.track import RegionCoaster
 
 log = get_logger("stages.infer")
 
@@ -64,6 +65,16 @@ def _encode_wire(frame_bgr: np.ndarray, wire_format: str) -> np.ndarray:
 #: per-process frame-seed sequence for device_synth mode (the GIL makes
 #: itertools.count().__next__ atomic enough for distinct seeds)
 _SYNTH_SEQ = itertools.count()
+
+
+def _parse_interval(properties: dict) -> int:
+    """``inference-interval``: a positive int, or ``"adaptive"`` —
+    the motion gate replaces the static schedule (stages/gate.py), so
+    the static interval collapses to 1."""
+    iv = properties.get("inference-interval", 1)
+    if isinstance(iv, str) and iv.strip().lower() == "adaptive":
+        return 1
+    return max(1, int(iv))
 
 
 def _wire_frame(
@@ -130,7 +141,7 @@ class DetectStage(AsyncStage):
                 "effective threshold is %.2f",
                 name, self.threshold, ENGINE_SCORE_FLOOR, ENGINE_SCORE_FLOOR,
             )
-        self.interval = max(1, int(properties.get("inference-interval", 1)))
+        self.interval = _parse_interval(properties)
         self.model = hub.model(model_key)
         self.wire = "seed" if hub.device_synth else hub.wire_format
         self.ingest_size = _wire_safe_size(
@@ -144,12 +155,24 @@ class DetectStage(AsyncStage):
             synth_wire_hw=self.ingest_size,
         )
         _warm_engine(hub, self.engine, self.ingest_size, self.wire)
+        #: content-adaptive motion gate (stages/gate.py): None unless
+        #: inference-interval=adaptive or EVAM_GATE=on
+        self.gate = maybe_gate(
+            properties, engine_name=getattr(self.engine, "name", ""))
+        #: CoW reuse + constant-velocity coasting of the last inferred
+        #: detections (stages/track.py) — both skip paths share it
+        self._coaster = RegionCoaster()
         self._count = 0
         self._last_regions: list[Region] = []
 
     def submit(self, ctx: FrameContext) -> Future | None:
         self._count += 1
-        if (self._count - 1) % self.interval:
+        if self.gate is not None:
+            if ctx.frame is not None and not self.gate.decide(ctx.frame):
+                # motion gate skip: coast the last detections forward
+                ctx.scratch["gate_coast"] = self.gate.consecutive_skips
+                return None
+        elif (self._count - 1) % self.interval:
             return None  # inference-interval skip: reuse last regions
         return self.engine.submit(
             priority=ctx.priority,
@@ -157,9 +180,11 @@ class DetectStage(AsyncStage):
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
-            # inference-interval skip: reuse last detections, deep-copied
-            # so downstream stages never mutate shared cross-frame state.
-            ctx.regions.extend(copy.deepcopy(self._last_regions))
+            # skipped frame: shallow-frozen clones of the last
+            # detections (value-equal to the old per-frame deepcopy),
+            # velocity-coasted when the motion gate did the skipping.
+            steps = ctx.scratch.pop("gate_coast", 0)
+            ctx.regions.extend(self._coaster.coast(steps))
             return [ctx]
         labels = self.model.labels
         regions = []
@@ -184,6 +209,7 @@ class DetectStage(AsyncStage):
             )
             regions.append(region)
         self._last_regions = regions
+        self._coaster.observe(regions)
         ctx.regions.extend(regions)
         return [ctx]
 
@@ -453,7 +479,7 @@ class FusedDetectClassifyStage(AsyncStage):
         self.det_threshold = float(det_props.get("threshold", 0.5))
         self.cls_threshold = float(cls_props.get("threshold", 0.0))
         self.object_class = cls_props.get("object-class")
-        self.interval = max(1, int(det_props.get("inference-interval", 1)))
+        self.interval = _parse_interval(det_props)
         self.det_model = hub.model(det_key)
         allowed = None
         if self.object_class:
@@ -476,12 +502,21 @@ class FusedDetectClassifyStage(AsyncStage):
         )
         self.cls_model = hub.model(cls_key)
         _warm_engine(hub, self.engine, self.ingest_size, self.wire)
+        #: motion gate + coasting — same submit-side gating contract
+        #: as DetectStage (detect properties drive it)
+        self.gate = maybe_gate(
+            det_props, engine_name=getattr(self.engine, "name", ""))
+        self._coaster = RegionCoaster()
         self._count = 0
         self._last_regions: list[Region] = []
 
     def submit(self, ctx: FrameContext) -> Future | None:
         self._count += 1
-        if (self._count - 1) % self.interval:
+        if self.gate is not None:
+            if ctx.frame is not None and not self.gate.decide(ctx.frame):
+                ctx.scratch["gate_coast"] = self.gate.consecutive_skips
+                return None
+        elif (self._count - 1) % self.interval:
             return None
         return self.engine.submit(
             priority=ctx.priority,
@@ -489,7 +524,8 @@ class FusedDetectClassifyStage(AsyncStage):
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
         if result is None:
-            ctx.regions.extend(copy.deepcopy(self._last_regions))
+            steps = ctx.scratch.pop("gate_coast", 0)
+            ctx.regions.extend(self._coaster.coast(steps))
             return [ctx]
         det_labels = self.det_model.labels
         head_slices = []
@@ -532,5 +568,6 @@ class FusedDetectClassifyStage(AsyncStage):
                     )
             regions.append(region)
         self._last_regions = regions
+        self._coaster.observe(regions)
         ctx.regions.extend(regions)
         return [ctx]

@@ -130,6 +130,90 @@ class IouTracker:
         self.tracks = survivors
 
 
+class RegionCoaster:
+    """Copy-on-write reuse + constant-velocity coasting of the last
+    inferred detections, shared by the motion gate's skip path and the
+    static ``inference-interval`` reuse path (stages/infer.py).
+
+    The old skip path deep-copied ``_last_regions`` per skipped frame
+    per stream — measurable host overhead at 64-stream fan-in, and a
+    frozen box under motion. Here:
+
+    * ``observe(regions)`` records each real inference and estimates
+      per-region velocity by class-gated greedy IoU match against the
+      previous inference (the same association rule as IouTracker);
+    * ``reuse()`` returns cheap shallow-frozen clones — fresh Region
+      objects (downstream stages mutate ``object_id`` and append to
+      ``tensors``) sharing the immutable Tensor payloads, value-equal
+      to the old deepcopy;
+    * ``coast(steps)`` returns the same clones advanced ``steps``
+      frames along the estimated velocity (clipped to [0, 1]) — the
+      tracker's short-term extrapolation applied at the detection
+      layer, so a gated-away frame still tracks a moving object.
+    """
+
+    def __init__(self) -> None:
+        self._regions: list[Region] = []
+        self._vels: list[np.ndarray] = []
+
+    def observe(self, regions: list[Region]) -> None:
+        vels = [np.zeros(4, np.float32) for _ in regions]
+        if self._regions and regions:
+            prev_boxes = np.stack([r.box for r in self._regions])
+            cur_boxes = np.stack([r.box for r in regions])
+            iou = _iou_matrix_np(prev_boxes, cur_boxes)
+            for pi, p in enumerate(self._regions):
+                for ci, c in enumerate(regions):
+                    if p.label_id != c.label_id:
+                        iou[pi, ci] = 0.0
+            used_prev: set[int] = set()
+            used_cur: set[int] = set()
+            order = np.dstack(
+                np.unravel_index(np.argsort(-iou, axis=None), iou.shape))[0]
+            for pi, ci in order:
+                if iou[pi, ci] < 0.05:
+                    break
+                if pi in used_prev or ci in used_cur:
+                    continue
+                used_prev.add(int(pi))
+                used_cur.add(int(ci))
+                vels[ci] = cur_boxes[ci] - prev_boxes[pi]
+        self._regions = regions
+        self._vels = vels
+
+    @staticmethod
+    def _clone(region: Region, delta: np.ndarray) -> Region:
+        box = np.clip(region.box + delta, 0.0, 1.0)
+        out = Region(
+            x0=float(box[0]), y0=float(box[1]),
+            x1=float(box[2]), y1=float(box[3]),
+            confidence=region.confidence,
+            label_id=region.label_id,
+            label=region.label,
+            object_id=region.object_id,
+            # fresh list, shared (never-mutated) Tensor payloads: a
+            # downstream append touches only this frame's clone
+            tensors=list(region.tensors),
+        )
+        return out
+
+    def reuse(self) -> list[Region]:
+        """Value-equal stand-ins for the last detections (steps=0) —
+        the byte-identical replacement for the old deepcopy path."""
+        zero = np.zeros(4, np.float32)
+        return [self._clone(r, zero) for r in self._regions]
+
+    def coast(self, steps: int) -> list[Region]:
+        """The last detections advanced ``steps`` frames along their
+        estimated velocities (the gate's skip path)."""
+        if steps <= 0:
+            return self.reuse()
+        return [
+            self._clone(r, v * float(steps))
+            for r, v in zip(self._regions, self._vels)
+        ]
+
+
 class TrackStage(Stage):
     #: tracking-type → (coasting frames override, motion extrapolation)
     _TYPES = {

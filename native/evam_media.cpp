@@ -158,6 +158,41 @@ void resize_bgr(const uint8_t *src, int sh, int sw,
     }
 }
 
-int evam_native_version() { return 1; }
+// Downsampled luma grid for the motion gate (evam_tpu/stages/gate.py):
+// one gh x gw uint8 grid summarizing the frame's BT.601 luma, sampled
+// on a fixed (gh*S) x (gw*S) point lattice instead of a full pass —
+// per-frame cost is O(gh*gw*S^2) regardless of resolution, cheap
+// enough for the decode/stream thread at 64-stream fan-in. Integer
+// math only, and the numpy fallback (evam_tpu/native.py) replays the
+// exact same lattice + arithmetic, so gate decisions are identical
+// with or without the shared library.
+void luma_grid(const uint8_t *src, int h, int w,
+               uint8_t *dst, int gh, int gw) {
+    const int S = 4;                       // sample points per cell edge
+    const int N = gh * S, M = gw * S;
+#pragma omp parallel for schedule(static)
+    for (int gy = 0; gy < gh; ++gy) {
+        for (int gx = 0; gx < gw; ++gx) {
+            int acc = 0;
+            for (int j = 0; j < S; ++j) {
+                int i = gy * S + j;
+                int y = (int)(((2LL * i + 1) * h) / (2 * N));
+                const uint8_t *row = src + (size_t)y * w * 3;
+                for (int k = 0; k < S; ++k) {
+                    int jj = gx * S + k;
+                    int x = (int)(((2LL * jj + 1) * w) / (2 * M));
+                    const uint8_t *p = row + (size_t)x * 3;
+                    // BT.601 luma, same matrix as bgr_to_yuv above
+                    int yv = ((66 * p[2] + 129 * p[1] + 25 * p[0] + 128)
+                              >> 8) + 16;
+                    acc += yv < 0 ? 0 : (yv > 255 ? 255 : yv);
+                }
+            }
+            dst[(size_t)gy * gw + gx] = (uint8_t)(acc / (S * S));
+        }
+    }
+}
+
+int evam_native_version() { return 2; }
 
 }  // extern "C"

@@ -84,6 +84,12 @@ def test_bench_serialize_compile_serve_emits_contract_line():
         assert set(data[key]) == {"realtime", "standard", "batch"}, key
     assert data["sched_admitted"]["standard"] == 2
     assert sum(data["sched_rejected"].values()) == 0
+    # content-adaptive gating outcome rides the line too
+    # (stages/gate.py): this run is ungated — the A/B baseline shape
+    # is all-zero counts, fixed keys
+    assert {"streams", "ran", "skipped", "skip_rate",
+            "skipped_fps"} == set(data["gate"])
+    assert data["gate"]["skipped"] == 0
 
 
 def test_bench_hostpath_slot_not_slower_than_legacy():

@@ -1,0 +1,408 @@
+"""Tier-1 motion-gate tests (stages/gate.py): controller hysteresis /
+max-skip / forced-refresh units, static-vs-moving skip behavior on
+real frames, EVAM_GATE=off A/B identity through DetectStage, tracker
+coasting on skipped frames, copy-on-write reuse (the deepcopy
+replacement), and gate-aware admission capacity.
+
+Engine-backed paths use duck-typed fakes (no jax, no compile) so the
+module stays in the <90 s fast suite."""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from evam_tpu.obs.metrics import metrics
+from evam_tpu.sched.admission import AdmissionController
+from evam_tpu.sched.classes import SchedConfig
+from evam_tpu.stages import gate as gate_mod
+from evam_tpu.stages.context import FrameContext, Region, Tensor
+from evam_tpu.stages.gate import GateConfig, MotionGate, maybe_gate
+from evam_tpu.stages.infer import DetectStage
+from evam_tpu.stages.track import RegionCoaster
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    gate_mod.registry.reset()
+    yield
+    gate_mod.registry.reset()
+
+
+def _frame(fill: int = 20, square=None, h: int = 96, w: int = 96):
+    f = np.full((h, w, 3), fill, np.uint8)
+    if square is not None:
+        x, y = square
+        f[y:y + 24, x:x + 24] = (64, 160, 240)
+    return f
+
+
+def _ctx(frame, seq=0):
+    return FrameContext(frame=frame, pts_ns=seq, seq=seq, stream_id="t")
+
+
+# --------------------------------------------------------- controller
+
+
+class TestController:
+    def _gate(self, **kw):
+        cfg = GateConfig(enabled=True, **kw)
+        return MotionGate(cfg, engine_name="e")
+
+    def test_first_frame_always_runs(self):
+        g = self._gate()
+        assert g.apply(float("inf")) is True
+        assert g.ran == 1
+
+    def test_hysteresis_enter_and_exit(self):
+        g = self._gate(threshold=2.0, threshold_lo=1.0, max_skip=100,
+                       refresh=0)
+        g.apply(float("inf"))
+        assert g.apply(0.0) is False          # static: skip
+        assert g.apply(3.0) is True           # crosses hi: moving
+        # between lo and hi: state HOLDS (still moving), no flicker
+        assert g.apply(1.5) is True
+        assert g.apply(0.5) is False          # below lo: static again
+        # between the thresholds while static: still static
+        assert g.apply(1.5) is False
+
+    def test_max_skip_bounds_staleness(self):
+        g = self._gate(threshold=2.0, threshold_lo=1.0, max_skip=4,
+                       refresh=0)
+        g.apply(float("inf"))
+        runs = [g.apply(0.0) for _ in range(20)]
+        # every 5th frame is forced: skips never exceed 4 in a row
+        assert g.max_consecutive_skips == 4
+        assert runs.count(True) == 4
+        for i, r in enumerate(runs):
+            assert r is (i % 5 == 4)
+
+    def test_forced_refresh_period(self):
+        g = self._gate(threshold=2.0, threshold_lo=1.0, max_skip=1000,
+                       refresh=10)
+        g.apply(float("inf"))
+        runs = [g.apply(0.0) for _ in range(30)]
+        assert runs.count(True) == 3
+        assert all(r is (i % 10 == 9) for i, r in enumerate(runs))
+
+    def test_static_scene_skips_majority_moving_skips_none(self):
+        g = self._gate(max_skip=8)
+        static = _frame(square=(10, 10))
+        for _ in range(40):
+            g.decide(static)
+        assert g.skipped / (g.ran + g.skipped) >= 0.8
+
+        m = self._gate(max_skip=8)
+        for i in range(40):
+            m.decide(_frame(square=((i * 17) % 70, (i * 11) % 70)))
+        assert m.skipped == 0
+
+    def test_slow_drift_accumulates_against_anchor(self):
+        # per-frame diff stays under threshold, but the reference is
+        # the last INFERRED frame — drift eventually crosses it
+        g = self._gate(threshold=2.0, threshold_lo=1.0, max_skip=1000,
+                       refresh=0)
+        for i in range(40):
+            g.decide(_frame(fill=20 + i))
+        assert g.ran >= 2  # re-anchored at least once past the first
+
+    def test_metrics_and_snapshot(self):
+        g = MotionGate(GateConfig(enabled=True, max_skip=8),
+                       engine_name="metrics-probe")
+        static = _frame()
+        for _ in range(10):
+            g.decide(static)
+        snap = g.snapshot()
+        assert snap["ran"] + snap["skipped"] == 10
+        assert snap["max_skip"] == 8
+        assert metrics.get_counter(
+            "evam_gate_ran", {"engine": "metrics-probe"}) == snap["ran"]
+        assert metrics.get_counter(
+            "evam_gate_skipped",
+            {"engine": "metrics-probe"}) == snap["skipped"]
+
+
+# ------------------------------------------------------------- config
+
+
+class TestGateConfig:
+    def test_off_by_default(self):
+        assert maybe_gate({}) is None
+
+    def test_adaptive_interval_enables(self):
+        g = maybe_gate({"inference-interval": "adaptive"})
+        assert g is not None and g.cfg.enabled
+
+    def test_env_on_enables_env_off_kills(self, monkeypatch):
+        monkeypatch.setenv("EVAM_GATE", "on")
+        assert maybe_gate({}) is not None
+        monkeypatch.setenv("EVAM_GATE", "off")
+        assert maybe_gate({"inference-interval": "adaptive"}) is None
+
+    def test_properties_beat_env(self, monkeypatch):
+        monkeypatch.setenv("EVAM_GATE_MAX_SKIP", "3")
+        monkeypatch.setenv("EVAM_GATE_THRESHOLD", "5.0")
+        cfg = GateConfig.from_properties(
+            {"inference-interval": "adaptive", "gate-max-skip": 7})
+        assert cfg.max_skip == 7       # property wins
+        assert cfg.threshold == 5.0    # env fills the rest
+        assert cfg.threshold_lo == 2.5
+
+
+# ------------------------------------------------------------ coaster
+
+
+def _region(x0=0.1, y0=0.1, x1=0.3, y1=0.3, label_id=0):
+    r = Region(x0=x0, y0=y0, x1=x1, y1=y1, confidence=0.9,
+               label_id=label_id, label="person")
+    r.tensors.append(Tensor(name="detection", confidence=0.9,
+                            label_id=label_id, label="person",
+                            is_detection=True))
+    return r
+
+
+class TestRegionCoaster:
+    def test_reuse_is_value_equal_and_cow(self):
+        c = RegionCoaster()
+        orig = _region()
+        c.observe([orig])
+        clone = c.reuse()[0]
+        assert clone is not orig
+        assert clone.box.tolist() == orig.box.tolist()
+        assert clone.confidence == orig.confidence
+        assert clone.tensors == orig.tensors  # shared payloads
+        # downstream mutation of the clone must not leak back (the
+        # guarantee the old per-frame deepcopy existed for)
+        clone.object_id = 42
+        clone.tensors.append(Tensor(name="color", confidence=0.5,
+                                    label_id=1, label="red"))
+        assert orig.object_id is None
+        assert len(orig.tensors) == 1
+
+    def test_coast_extrapolates_velocity(self):
+        c = RegionCoaster()
+        c.observe([_region(x0=0.10, x1=0.30)])
+        c.observe([_region(x0=0.14, x1=0.34)])  # moved +0.04 in x
+        coasted = c.coast(2)[0]
+        assert coasted.x0 == pytest.approx(0.22, abs=1e-6)
+        assert coasted.x1 == pytest.approx(0.42, abs=1e-6)
+        assert coasted.y0 == pytest.approx(0.10, abs=1e-6)
+
+    def test_coast_clips_to_unit_box(self):
+        c = RegionCoaster()
+        c.observe([_region(x0=0.80, x1=0.95)])
+        c.observe([_region(x0=0.90, x1=1.00)])
+        coasted = c.coast(5)[0]
+        assert coasted.x1 == 1.0
+        assert coasted.x0 <= 1.0
+
+    def test_class_gated_matching(self):
+        c = RegionCoaster()
+        c.observe([_region(label_id=0)])
+        # same place, different class: NOT a continuation — vel stays 0
+        c.observe([_region(x0=0.14, x1=0.34, label_id=1)])
+        coasted = c.coast(3)[0]
+        assert coasted.x0 == pytest.approx(0.14, abs=1e-6)
+
+
+# ----------------------------------------------- stage-level (fakes)
+
+
+class _FakePre:
+    height = 64
+    width = 64
+
+
+class _FakeModel:
+    preprocess = _FakePre()
+    labels = ["person", "vehicle", "bike"]
+
+
+class _FakeEngine:
+    """Duck-typed BatchEngine: resolves instantly with scripted rows."""
+
+    name = "detect:fake"
+
+    def __init__(self, rows_iter):
+        self._rows = rows_iter
+        self.submits = 0
+
+    def submit(self, priority="standard", **inputs) -> Future:
+        self.submits += 1
+        fut: Future = Future()
+        fut.set_result(next(self._rows))
+        return fut
+
+    def set_example(self, **kw):
+        pass
+
+
+class _FakeHub:
+    device_synth = False
+    wire_format = "bgr"
+    warmup = False
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def model(self, key):
+        return _FakeModel()
+
+    def engine(self, kind, key, instance_id=None, **kw):
+        return self._engine
+
+
+def _det_rows(x0=0.1, n=1):
+    """One packed engine result: n valid person rows at x0."""
+    rows = np.zeros((8, 7), np.float32)
+    for i in range(n):
+        rows[i] = [x0, 0.1, x0 + 0.2, 0.3, 0.9, 0, 1.0]
+    return rows
+
+
+def _run_frames(stage, frames):
+    """submit+complete each frame through the stage; returns per-frame
+    region lists."""
+    out = []
+    for i, f in enumerate(frames):
+        ctx = _ctx(f, seq=i)
+        fut = stage.submit(ctx)
+        stage.complete(ctx, fut.result() if fut is not None else None)
+        out.append(ctx.regions)
+    return out
+
+
+class TestDetectStageGating:
+    def test_gated_static_stream_skips_and_coasts(self):
+        eng = _FakeEngine(itertools.repeat(_det_rows()))
+        stage = DetectStage(
+            "det", "m", {"inference-interval": "adaptive"}, _FakeHub(eng))
+        assert stage.gate is not None
+        static = _frame(square=(10, 10))
+        outs = _run_frames(stage, [static] * 30)
+        assert eng.submits < 30 * 0.4  # most frames gated away
+        # every skipped frame still carries (coasted) detections
+        assert all(len(r) == 1 for r in outs)
+        assert stage.gate.max_consecutive_skips <= stage.gate.cfg.max_skip
+
+    def test_coasted_boxes_move_with_velocity(self):
+        # two real inferences moving +0.05/frame in x, then a static
+        # scene: the fake engine keeps "detecting" motion is over, so
+        # force skips via a static frame sequence after the movers
+        rows = iter([_det_rows(0.10), _det_rows(0.15)]
+                    + [_det_rows(0.15)] * 50)
+        stage = DetectStage(
+            "det", "m",
+            {"inference-interval": "adaptive", "gate-threshold": 1.0},
+            _FakeHub(_FakeEngine(rows)))
+        moving = [_frame(square=(10, 10)), _frame(square=(40, 40))]
+        static = [_frame(square=(40, 40))] * 3
+        outs = _run_frames(stage, moving + static)
+        # frames 2..4 are gate-skips: boxes coast along +0.05/frame
+        assert outs[2][0].x0 == pytest.approx(0.20, abs=1e-6)
+        assert outs[3][0].x0 == pytest.approx(0.25, abs=1e-6)
+
+    def test_gate_off_is_identical_to_ungated(self, monkeypatch):
+        frames = [_frame(square=((i * 17) % 70, (i * 11) % 70))
+                  for i in range(12)]
+
+        def run(props):
+            eng = _FakeEngine(itertools.repeat(_det_rows()))
+            stage = DetectStage("det", "m", dict(props), _FakeHub(eng))
+            outs = _run_frames(stage, frames)
+            return eng.submits, [
+                [(r.x0, r.y0, r.x1, r.y1, r.confidence, r.label_id,
+                  r.object_id, len(r.tensors)) for r in regions]
+                for regions in outs
+            ]
+
+        monkeypatch.setenv("EVAM_GATE", "off")
+        with_props = run({"inference-interval": "adaptive",
+                          "gate-threshold": 0.5})
+        monkeypatch.delenv("EVAM_GATE")
+        plain = run({})
+        assert with_props == plain  # kill switch = byte-identical path
+
+    def test_interval_skip_reuses_without_deepcopy_leak(self):
+        eng = _FakeEngine(itertools.repeat(_det_rows()))
+        stage = DetectStage("det", "m", {"inference-interval": 3},
+                            _FakeHub(eng))
+        frames = [_frame(square=(10, 10))] * 6
+        outs = _run_frames(stage, frames)
+        assert eng.submits == 2
+        # skipped frames got value-equal clones, not the same objects
+        assert outs[1][0] is not outs[0][0]
+        assert outs[1][0].box.tolist() == outs[0][0].box.tolist()
+        # mutating a skipped frame's region never corrupts the source
+        outs[1][0].tensors.append(Tensor(name="x", confidence=1.0,
+                                         label_id=0, label="x"))
+        assert len(stage._last_regions[0].tensors) == 1
+
+
+# ----------------------------------------------- gate-aware admission
+
+
+class _StatsHub:
+    max_batch = 16
+
+    def stats(self):
+        return {}
+
+
+class TestGateAwareAdmission:
+    def _controller(self, capacity=100.0, admit_util=1.0):
+        cfg = SchedConfig(capacity_fps=capacity, admit_util=admit_util)
+        return AdmissionController(_StatsHub(), cfg)
+
+    def _static_gate(self, skips=100):
+        """A live gate whose recent window is full of skips."""
+        g = MotionGate(GateConfig(enabled=True), engine_name="e")
+        now = g._clock()
+        for k in range(skips):
+            g._skip_times.append(now)
+        return g
+
+    def test_effective_demand_subtracts_gate_credit(self):
+        ctrl = self._controller()
+        ctrl.admit("standard", 60.0)
+        assert ctrl.demand_fps() == 60.0
+        g = self._static_gate(skips=100)  # 100 skips / 5 s window
+        assert gate_mod.registry.skipped_fps() == pytest.approx(20.0)
+        assert ctrl.effective_demand_fps() == pytest.approx(40.0)
+        assert ctrl.utilization() == pytest.approx(0.4)
+        del g
+
+    def test_static_scenes_grow_admission_headroom(self):
+        # standard-class ceiling = 0.95 * 0.85 headroom = 0.8075
+        ctrl = self._controller(capacity=100.0, admit_util=0.95)
+        ctrl.admit("standard", 60.0)
+        # ungated, another 60 fps start projects 1.2 > the ceiling
+        from evam_tpu.sched.admission import AdmissionError
+
+        with pytest.raises(AdmissionError):
+            ctrl.admit("standard", 60.0)
+        # a mostly-static gated stream credits back 40 fps of demand:
+        # the same start now projects (60-40+60)/100 = 0.8 <= 0.8075
+        g = self._static_gate(skips=200)
+        assert ctrl.admit("standard", 60.0) is not None
+        del g
+
+    def test_snapshot_reports_effective_demand(self):
+        ctrl = self._controller()
+        ctrl.admit("standard", 30.0)
+        snap = ctrl.snapshot()
+        assert snap["demand_fps"] == 30.0
+        assert snap["effective_demand_fps"] == 30.0  # no gates live
+
+    def test_registry_summary_shape(self):
+        g = self._static_gate(skips=10)
+        g.apply(float("inf"))
+        g.apply(0.0)
+        s = gate_mod.registry.summary()
+        assert {"streams", "ran", "skipped", "skip_rate",
+                "skipped_fps"} == set(s)
+        assert s["ran"] == 1 and s["skipped"] == 1
+        del g

@@ -121,6 +121,45 @@ class TestSupervisedEngine:
         finally:
             sup.stop()
 
+    def test_cumulative_counters_survive_rebuild(self, monkeypatch):
+        """hub.py shed_totals note: a rebuild swaps in a fresh engine
+        with zeroed local counters — the supervised handle must fold
+        the quarantined engine's cumulative counts into a carry so
+        /healthz, /engines and the bench line stay MONOTONIC."""
+        sup = SupervisedEngine(
+            "sup-carry", _toy_factory("sup-carry"),
+            max_restarts=3, restart_window_s=60.0, backoff_s=0.05)
+        try:
+            for v in range(3):
+                sup.submit(
+                    x=np.full((2,), float(v), np.float32)).result(timeout=30)
+            pre = sup.stats
+            assert pre.batches >= 1 and pre.items == 3
+            pre_batches, pre_items = pre.batches, pre.items
+            pre_launch = pre.stage_seconds.get("launch", 0.0)
+            # simulate sheds on the live engine, then wedge it
+            sup._engine.shed_counts = lambda: {"batch": 5}
+            _wedge_env(monkeypatch, "wedge=1,wedge_n=1,wedge_s=4")
+            fut = sup.submit(x=np.zeros((2,), np.float32))
+            with pytest.raises(TimeoutError):
+                fut.result(timeout=15)
+            _wait_for(lambda: sup.state == "running" and sup.restarts == 1,
+                      msg="rebuild + re-admission")
+            # fresh engine: local counters are zeroed...
+            assert sup._engine.stats.items == 0
+            # ...but the handle's view carried everything across
+            assert sup.shed_counts() == {"batch": 5}
+            assert sup.stats.batches >= pre_batches
+            assert sup.stats.items >= pre_items
+            assert sup.stats.stage_seconds.get("launch", 0.0) >= pre_launch
+            # and keeps counting monotonically on the new engine (the
+            # wedged item was failed by the watchdog, never recorded)
+            sup.submit(x=np.zeros((2,), np.float32)).result(timeout=30)
+            assert sup.stats.items == pre_items + 1
+            assert sup.stats.mean_occupancy > 0
+        finally:
+            sup.stop()
+
     def test_dispatcher_death_triggers_rebuild(self):
         """The second wedge signal: a dispatcher thread that DIES
         (not blocks) is detected by liveness, not the stalled flag."""

@@ -322,6 +322,8 @@ def params_table(pipeline: dict) -> str:
         default = schema.get("default")
         default = "—" if default is None else f"`{json.dumps(default)}`"
         typ = schema.get("type", "object")
+        if isinstance(typ, list):  # JSON Schema union, e.g. adaptive
+            typ = " \\| ".join(typ)
         rows.append(f"| `{name}` | {typ} | {default} | {bound} |")
     return "\n".join(rows)
 
