@@ -73,6 +73,17 @@ class TPUSettings(BaseModel):
     #: path, kept byte-identical for A/B (tools/bench_transfer.py).
     #: EVAM_SERIALIZE_COMPILE=1 forces inline regardless.
     transfer: Literal["pipelined", "inline"] = "pipelined"
+    #: ragged batching (engine/ragged.py): "packed" packs classify
+    #: region sets into one fixed masked-compute device shape (row
+    #: length/offset vectors, Ragged Paged Attention style) and
+    #: consolidates adjacent batch buckets onto shared programs;
+    #: "off" (default until a TPU accuracy window) keeps the dense
+    #: bucketed path byte-identical for A/B (tools/bench_ragged.py).
+    ragged: Literal["packed", "off"] = "off"
+    #: packed unit rows budgeted per batch row (how many region slots
+    #: a packed classify batch carries per frame ON AVERAGE; floored
+    #: at the stage ROI budget so a lone full frame always fits)
+    ragged_unit_budget: int = 4
 
 
 class SchedSettings(BaseModel):
@@ -196,6 +207,8 @@ class Settings(BaseModel):
             "EVAM_ENGINE_RESTART_BACKOFF_S": ("restart_backoff_s", float),
             "EVAM_FIRST_BATCH_GRACE": ("first_batch_grace", float),
             "EVAM_TRANSFER": ("transfer", str),
+            "EVAM_RAGGED": ("ragged", str),
+            "EVAM_RAGGED_UNIT_BUDGET": ("ragged_unit_budget", int),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

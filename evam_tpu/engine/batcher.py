@@ -70,6 +70,11 @@ import jax
 import numpy as np
 
 from evam_tpu.engine import devlock
+from evam_tpu.engine.ragged import (
+    RaggedSpec,
+    consolidate_buckets,
+    ragged_mode,
+)
 from evam_tpu.engine.ringbuf import STAGES, SealedBatch, SlotRing
 from evam_tpu.obs import get_logger, metrics
 from evam_tpu.obs.faults import current as active_faults
@@ -91,6 +96,10 @@ class _WorkItem:
     future: Future
     t_submit: float
     priority: str = DEFAULT_PRIORITY
+    #: real unit rows this item carries (a frame's region count for
+    #: classify engines) — honest-occupancy metadata. None = unknown;
+    #: accounting then assumes the pessimistic dense budget.
+    units: int | None = None
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -114,6 +123,27 @@ class EngineStats:
     batches: int = 0
     items: int = 0
     occupancy_sum: float = 0.0
+    #: real vs computed unit rows (ragged accounting, engine/ragged.py):
+    #: a classify batch COMPUTES bucket × roi_budget unit rows on the
+    #: dense path (unit_slots) however few regions the frames really
+    #: carried (units). units/unit_slots is the honest occupancy the
+    #: per-item n/bucket number silently overstates. Frame-per-row
+    #: engines count 1 unit per item, so the two occupancies agree.
+    units: int = 0
+    unit_slots: int = 0
+    #: per-bucket dispatched-batch counts (pad-tax attribution:
+    #: which program shapes the traffic actually lands in)
+    bucket_batches: dict[int, int] = dataclasses.field(default_factory=dict)
+    #: compile-cache accounting: distinct bucket programs this engine
+    #: has executed (each cost a jit trace + XLA compile) and the
+    #: cumulative wall seconds their first batches took — warmup or
+    #: mid-traffic. Bucket consolidation's "compile-cache entries
+    #: drop" claim is measured against these, not asserted.
+    compiled_programs: int = 0
+    compile_seconds: float = 0.0
+    #: submits past the top bucket that had to be split across batches
+    #: instead of silently clamped (oversize-split contract)
+    oversize_splits: int = 0
     #: cumulative per-stage host clock (seconds), keyed by
     #: ringbuf.STAGES — submit_wait/slot_write/seal come from the
     #: dispatcher, h2d_issue from the upload span, h2d_wait/launch
@@ -125,6 +155,28 @@ class EngineStats:
     @property
     def mean_occupancy(self) -> float:
         return self.occupancy_sum / self.batches if self.batches else 0.0
+
+    @property
+    def unit_occupancy(self) -> float:
+        """Real units / computed unit rows — the honest pad-tax view."""
+        return self.units / self.unit_slots if self.unit_slots else 0.0
+
+    def absorb(self, other: "EngineStats") -> None:
+        """Fold another engine's cumulative counters into this one
+        (supervisor rebuild carry — /healthz, /engines and the bench
+        line must stay monotonic across quarantine swaps)."""
+        self.batches += other.batches
+        self.items += other.items
+        self.occupancy_sum += other.occupancy_sum
+        self.units += other.units
+        self.unit_slots += other.unit_slots
+        self.compiled_programs += other.compiled_programs
+        self.compile_seconds += other.compile_seconds
+        self.oversize_splits += other.oversize_splits
+        for b, c in other.bucket_batches.items():
+            self.bucket_batches[b] = self.bucket_batches.get(b, 0) + c
+        for k, v in other.stage_seconds.items():
+            self.stage_seconds[k] = self.stage_seconds.get(k, 0.0) + v
 
     def add_stage(self, stage: str, dt: float) -> None:
         self.stage_seconds[stage] = self.stage_seconds.get(stage, 0.0) + dt
@@ -164,6 +216,8 @@ class BatchEngine:
         first_batch_grace: float = 10.0,
         sched: SchedConfig | None = None,
         transfer: str | None = None,
+        ragged: str | None = None,
+        ragged_spec: RaggedSpec | None = None,
     ):
         self.name = name
         self.plan = plan
@@ -180,6 +234,26 @@ class BatchEngine:
             raise ValueError(
                 f"EVAM_BATCH_ASSEMBLY must be 'slot' or 'legacy', "
                 f"got {self.assembly!r}")
+        #: ragged batching (engine/ragged.py, EVAM_RAGGED): "packed"
+        #: packs variable-size items into one fixed device shape with
+        #: a row_len/row_offset descriptor + masked compute, and thins
+        #: the bucket ladder so adjacent shapes share a program; "off"
+        #: (default) keeps the dense bucketed path byte-identical for
+        #: A/B (tools/bench_ragged.py). Packing needs the staging ring
+        #: — the legacy stack+concat assembly forces it off.
+        self.ragged = ragged_mode(ragged)
+        if self.ragged == "packed" and self.assembly == "legacy":
+            log.warning(
+                "engine %s: EVAM_RAGGED=packed requires the slot "
+                "staging ring; EVAM_BATCH_ASSEMBLY=legacy forces it "
+                "off", name)
+            self.ragged = "off"
+        #: unit-level shape of the one ragged input (classify-family
+        #: engines). Attached even in "off" mode so the occupancy
+        #: accounting stays honest about per-item ROI padding; packing
+        #: itself is mode-gated.
+        self.ragged_spec = ragged_spec
+        self._packed = self.ragged == "packed" and ragged_spec is not None
         #: device-transfer pipeline: "pipelined" (default) issues the
         #: H2D copy on the dispatcher and launches from a dedicated
         #: launcher thread — batch N+1's upload overlaps batch N's
@@ -260,6 +334,11 @@ class BatchEngine:
             self.buckets.append(b)
             b *= 2
         self.buckets.append(top)
+        if self.ragged == "packed":
+            # bucket consolidation (engine/ragged.py): adjacent shape
+            # buckets share a program instead of each paying compile +
+            # program memory + a cold first-batch stall
+            self.buckets = consolidate_buckets(self.buckets)
 
         #: staging ring: blocks sized to the LARGEST bucket so a
         #: sealed batch is always a contiguous [:bucket] prefix view;
@@ -269,8 +348,19 @@ class BatchEngine:
         #: depth × top-bucket batches. EVAM_STAGING_DEPTH overrides.
         depth = staging_depth or int(
             os.environ.get("EVAM_STAGING_DEPTH", "0")) or (max_in_flight + 1)
-        self._ring = (SlotRing(capacity=self.buckets[-1], depth=depth)
+        self._ring = (SlotRing(capacity=self.buckets[-1], depth=depth,
+                               ragged=(ragged_spec if self._packed
+                                       else None))
                       if self.assembly == "slot" else None)
+        #: jit-call input order: the packed-ragged step takes the
+        #: segment-id vector after the submit inputs (the stage never
+        #: submits it — the ring seals it per batch)
+        self._step_inputs = (input_names + ("seg",) if self._packed
+                             else input_names)
+        if self._packed and "seg" in input_names:
+            raise ValueError(
+                f"engine {name}: input name 'seg' is reserved by the "
+                "packed-ragged path")
 
         #: donate input device buffers into the jitted step so XLA can
         #: alias them for outputs — a real HBM/bandwidth win on TPU,
@@ -289,7 +379,10 @@ class BatchEngine:
                 step_fn,
                 in_shardings=(
                     plan.replicated(),
-                    *([plan.batch_sharding()] * len(input_names)),
+                    # every step input is batch-sharded — including
+                    # the packed-ragged seg vector, whose unit rows
+                    # scale with the (data-divisible) bucket
+                    *([plan.batch_sharding()] * len(self._step_inputs)),
                 ),
                 donate_argnums=donate,
             )
@@ -358,6 +451,7 @@ class BatchEngine:
     # ------------------------------------------------------------- API
 
     def submit(self, priority: str = DEFAULT_PRIORITY,
+               units: int | None = None,
                **inputs: np.ndarray) -> Future:
         """Enqueue one item (no batch dim); resolves to its packed row(s).
 
@@ -365,6 +459,13 @@ class BatchEngine:
         batch) when the engine runs the QoS layer (evam_tpu/sched/);
         without it the argument is accepted and ignored — the legacy
         single-FIFO path stays byte-identical.
+
+        ``units`` is honest-occupancy metadata: the item's REAL unit
+        rows (a frame's region count on classify engines, where the
+        dense path pads every item to the ROI budget). On the
+        packed-ragged path it is derived from the ragged input's
+        leading dim instead; the item then resolves to exactly its
+        own rows of the packed output.
 
         On the slot path this call COPIES the item's arrays into the
         staging block on the calling thread (ringbuf.write) — the
@@ -385,19 +486,23 @@ class BatchEngine:
             raise ValueError(
                 f"engine {self.name} expects inputs {self.input_names}, got {tuple(inputs)}"
             )
+        if self._packed:
+            units = int(np.asarray(
+                inputs[self.ragged_spec.input]).shape[0])
         fut: Future = Future()
         if self._classq is not None:
             if priority not in PRIORITIES:
                 raise ValueError(
                     f"unknown priority {priority!r}; valid: "
                     f"{'|'.join(PRIORITIES)}")
-            item = _WorkItem(inputs, fut, time.perf_counter(), priority)
+            item = _WorkItem(inputs, fut, time.perf_counter(), priority,
+                             units)
             try:
                 self._classq.put(priority, item)
             except RuntimeError:
                 raise RuntimeError(f"engine {self.name} is stopped") from None
             return fut
-        item = _WorkItem(inputs, fut, time.perf_counter())
+        item = _WorkItem(inputs, fut, time.perf_counter(), units=units)
         if self._ring is not None:
             try:
                 self._ring.write(inputs, item)
@@ -445,18 +550,41 @@ class BatchEngine:
         """Compile every bucket size ahead of traffic."""
         example = self._example_item()
         for b in self.buckets:
-            batch = {
-                k: np.broadcast_to(v, (b,) + v.shape).copy()
-                for k, v in example.items()
-            }
+            batch = self._warm_batch(example, b)
             # whole compile+execute+readback under one devlock span:
             # a warmup must never leave a half-overlapped RPC behind
+            t0 = time.perf_counter()
             with devlock.device_call(f"{self.name}:warmup"):
                 np.asarray(self._run(batch))
+            if b not in self._buckets_done:
+                # compile-cache accounting: a bucket's first run pays
+                # jit trace + XLA compile — bank it so consolidation's
+                # "fewer programs" claim is measurable
+                self.stats.compiled_programs += 1
+                self.stats.compile_seconds += time.perf_counter() - t0
             # warmed bucket = compiled: its batches get the plain
             # (not first-batch-grace) watchdog budget from here on
             self._buckets_done.add(b)
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
+
+    def _warm_batch(self, example: dict[str, np.ndarray],
+                    b: int) -> dict[str, np.ndarray]:
+        """Bucket-``b`` warmup batch from a per-item example. Packed
+        engines compile the PACKED shapes — the unit block + seg
+        vector at ``unit_rows(b)``, all-pad (seg −1) so the masked
+        step compiles without touching real data."""
+        spec = self.ragged_spec
+        batch: dict[str, np.ndarray] = {}
+        for k, v in example.items():
+            if self._packed and k == spec.input:
+                batch[k] = np.zeros(
+                    (spec.unit_rows(b),) + tuple(spec.unit_shape),
+                    spec.dtype)
+            else:
+                batch[k] = np.broadcast_to(v, (b,) + v.shape).copy()
+        if self._packed:
+            batch["seg"] = np.full((spec.unit_rows(b),), -1, np.int32)
+        return batch
 
     def warm_async(self, **example: np.ndarray) -> None:
         """Fire-and-forget bucket precompilation (serving path: kills
@@ -597,7 +725,43 @@ class BatchEngine:
         for b in self.buckets:
             if n <= b:
                 return b
+        # n past the top bucket would silently truncate: the dispatch
+        # paths split oversize submits across batches BEFORE bucketing
+        # (_split_oversize / stage_direct leftovers), so landing here
+        # is an accounting bug — be loud, never lossy
+        log.warning(
+            "engine %s: %d items exceed top bucket %d (oversize split "
+            "missed a path); clamping the SHAPE, items are preserved "
+            "by the caller's split", self.name, n, self.buckets[-1])
         return self.buckets[-1]
+
+    def _bucket_ragged(self, n: int, units: int) -> int:
+        """Packed-ragged bucket pick: the smallest rung that fits both
+        the item rows AND the packed unit rows (a few region-heavy
+        frames can need a bigger unit block than their item count
+        alone suggests)."""
+        spec = self.ragged_spec
+        for b in self.buckets:
+            if n <= b and units <= spec.unit_rows(b):
+                return b
+        return self.buckets[-1]
+
+    def _count_oversize_split(self, extra: int) -> None:
+        self.stats.oversize_splits += extra
+        metrics.inc("evam_engine_oversize_splits", float(extra),
+                    labels={"engine": self.name})
+
+    def _split_oversize(self, items: list[_WorkItem]) -> list[list[_WorkItem]]:
+        """Chunk a formed batch at the top bucket instead of letting
+        ``_bucket`` silently clamp (and the assembly paths truncate) a
+        packed submit past the largest shape. Each extra chunk counts
+        on ``evam_engine_oversize_splits``."""
+        top = self.buckets[-1]
+        if len(items) <= top:
+            return [items]
+        chunks = [items[i:i + top] for i in range(0, len(items), top)]
+        self._count_oversize_split(len(chunks) - 1)
+        return chunks
 
     def _run(self, batch: dict[str, np.ndarray],
              clock: dict[str, float] | None = None):
@@ -619,7 +783,7 @@ class BatchEngine:
         with devlock.device_call(f"{self.name}:launch"):
             t0 = time.perf_counter()
             arrays = []
-            for name in self.input_names:
+            for name in self._step_inputs:
                 a = batch[name]
                 if self.plan is not None:
                     a = jax.device_put(a, self.plan.batch_sharding())
@@ -642,12 +806,39 @@ class BatchEngine:
         metrics.set("evam_engine_queue_age_s", self.queue_age_s(),
                     {"engine": self.name})
 
-    def _record_batch(self, n: int, b: int,
-                      clock: dict[str, float]) -> None:
+    def _record_batch(self, n: int, b: int, clock: dict[str, float],
+                      items: list[_WorkItem] | None = None,
+                      sealed: SealedBatch | None = None) -> None:
         self.stats.batches += 1
         self.stats.items += n
         self.stats.occupancy_sum += n / b
+        # honest unit accounting (engine/ragged.py): what the program
+        # COMPUTED (unit_slots) vs the real work inside it (units).
+        # Packed batches know both exactly from the sealed descriptor;
+        # dense batches compute bucket × max_units unit rows and fall
+        # back to the pessimistic budget for items that didn't declare
+        # their real count. Frame-per-row engines: 1 unit per item.
+        spec = self.ragged_spec
+        if sealed is not None and sealed.row_len is not None:
+            self.stats.units += sealed.units
+            self.stats.unit_slots += sealed.unit_rows
+        elif spec is not None:
+            self.stats.unit_slots += b * spec.max_units
+            self.stats.units += sum(
+                (it.units if it.units is not None else spec.max_units)
+                for it in (items or []))
+        else:
+            self.stats.unit_slots += b
+            self.stats.units += n
+        self.stats.bucket_batches[b] = (
+            self.stats.bucket_batches.get(b, 0) + 1)
         metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
+        # live occupancy for operators (satellite: occupancy export) —
+        # both the item-fill mean and the pad-tax-honest unit view
+        metrics.set("evam_engine_occupancy", self.stats.mean_occupancy,
+                    {"engine": self.name})
+        metrics.set("evam_engine_unit_occupancy",
+                    self.stats.unit_occupancy, {"engine": self.name})
         self.refresh_queue_gauges()
         for stage, dt in clock.items():
             self.stats.add_stage(stage, dt)
@@ -686,7 +877,7 @@ class BatchEngine:
                 log.exception("engine %s step failed", self.name)
                 return
             self._done.put((out, items, t0, bid, sealed))
-            self._record_batch(n, b, clock)
+            self._record_batch(n, b, clock, items=items, sealed=sealed)
             return
         try:
             with devlock.device_call(f"{self.name}:h2d"):
@@ -696,16 +887,16 @@ class BatchEngine:
                     # optimization — always explicit
                     sharding = self.plan.batch_sharding()
                     dev = [jax.device_put(batch[name], sharding)
-                           for name in self.input_names]
+                           for name in self._step_inputs]
                 elif self._device_streams:
                     dev = [jax.device_put(batch[name])
-                           for name in self.input_names]
+                           for name in self._step_inputs]
                 else:
                     # CPU: let the launcher's jit call do the one
                     # host-side conversion exactly like inline does —
                     # an explicit device_put here would be a second
                     # copy with no DMA to overlap
-                    dev = [batch[name] for name in self.input_names]
+                    dev = [batch[name] for name in self._step_inputs]
                 clock["h2d_issue"] = time.perf_counter() - t0
         except Exception as exc:  # noqa: BLE001 — surface to every caller
             for it in items:
@@ -798,7 +989,7 @@ class BatchEngine:
                 log.exception("engine %s step failed", self.name)
                 continue
             self._done.put((out, items, t0, bid, sealed))
-            self._record_batch(n, b, clock)
+            self._record_batch(n, b, clock, items=items, sealed=sealed)
 
     def _drain_upload_q(self, exc: Exception) -> None:
         """Fail every uploaded-but-unlaunched batch (stop/abandon/
@@ -857,32 +1048,47 @@ class BatchEngine:
         """Assemble + launch one class-ordered batch: through the
         staging ring (zero per-batch allocation, copies on this
         thread) or the legacy stack+concat when
-        EVAM_BATCH_ASSEMBLY=legacy."""
-        clock: dict[str, float] = {
-            "submit_wait": time.perf_counter() - items[0].t_submit,
-        }
-        sealed = None
+        EVAM_BATCH_ASSEMBLY=legacy. A pick that exceeds the top
+        bucket's rows — or, packed, the unit block — is split across
+        batches in dispatch order instead of silently clamped
+        (oversize-split contract)."""
         if self._ring is not None:
-            try:
-                sealed = self._ring.stage_direct(
-                    [(it.inputs, it) for it in items],
-                    self._bucket, clock)
-            except RuntimeError:
-                exc = RuntimeError(f"engine {self.name} is stopped")
-                for it in items:
-                    _safe_set_exception(it.future, exc)
-                return
-            if sealed is None:
-                return  # every row failed its shape check
-            items, batch = sealed.items, sealed.arrays
-            n, b = sealed.n, sealed.bucket
-        else:
-            n = len(items)
+            bucket_fn = (self._bucket_ragged if self._packed
+                         else self._bucket)
+            staged = [(it.inputs, it) for it in items]
+            dispatched = 0
+            while staged:
+                clock: dict[str, float] = {
+                    "submit_wait":
+                        time.perf_counter() - staged[0][1].t_submit,
+                }
+                try:
+                    sealed, staged = self._ring.stage_direct(
+                        staged, bucket_fn, clock)
+                except RuntimeError:
+                    exc = RuntimeError(f"engine {self.name} is stopped")
+                    for _, it in staged:
+                        _safe_set_exception(it.future, exc)
+                    return
+                if sealed is None:
+                    continue  # every staged row failed its shape check
+                dispatched += 1
+                self._dispatch_batch(sealed.arrays, sealed.items,
+                                     sealed.n, sealed.bucket,
+                                     sealed.clock, sealed)
+            if dispatched > 1:
+                self._count_oversize_split(dispatched - 1)
+            return
+        for chunk in self._split_oversize(items):
+            clock = {
+                "submit_wait": time.perf_counter() - chunk[0].t_submit,
+            }
+            n = len(chunk)
             b = self._bucket(n)
             t_asm = time.perf_counter()
             batch = {}
             for name in self.input_names:
-                rows = [it.inputs[name] for it in items]
+                rows = [it.inputs[name] for it in chunk]
                 stacked = np.stack(rows)
                 if b > n:
                     pad = np.zeros((b - n,) + stacked.shape[1:],
@@ -890,16 +1096,16 @@ class BatchEngine:
                     stacked = np.concatenate([stacked, pad])
                 batch[name] = stacked
             clock["slot_write"] = time.perf_counter() - t_asm
-
-        self._dispatch_batch(batch, items, n, b, clock, sealed)
+            self._dispatch_batch(batch, chunk, n, b, clock, None)
 
     # ------------------------------------------------- slot dispatch
 
     def _dispatch_loop_slot(self) -> None:
         """Seal staged slots at the batch deadline and launch them —
         no stack, no pad concat, no per-batch allocation."""
+        bucket_fn = self._bucket_ragged if self._packed else self._bucket
         while True:
-            sealed = self._ring.next_batch(self.deadline_s, self._bucket)
+            sealed = self._ring.next_batch(self.deadline_s, bucket_fn)
             if sealed is None:
                 if self._stop.is_set():
                     break
@@ -942,23 +1148,26 @@ class BatchEngine:
                     break
                 items.append(nxt)
 
-            n = len(items)
-            b = self._bucket(n)
-            clock: dict[str, float] = {
-                "submit_wait": time.perf_counter() - items[0].t_submit,
-            }
-            t_asm = time.perf_counter()
-            batch: dict[str, np.ndarray] = {}
-            for name in self.input_names:
-                rows = [it.inputs[name] for it in items]
-                stacked = np.stack(rows)
-                if b > n:
-                    pad = np.zeros((b - n,) + stacked.shape[1:], stacked.dtype)
-                    stacked = np.concatenate([stacked, pad])
-                batch[name] = stacked
-            clock["slot_write"] = time.perf_counter() - t_asm
+            for chunk in self._split_oversize(items):
+                n = len(chunk)
+                b = self._bucket(n)
+                clock: dict[str, float] = {
+                    "submit_wait":
+                        time.perf_counter() - chunk[0].t_submit,
+                }
+                t_asm = time.perf_counter()
+                batch: dict[str, np.ndarray] = {}
+                for name in self.input_names:
+                    rows = [it.inputs[name] for it in chunk]
+                    stacked = np.stack(rows)
+                    if b > n:
+                        pad = np.zeros((b - n,) + stacked.shape[1:],
+                                       stacked.dtype)
+                        stacked = np.concatenate([stacked, pad])
+                    batch[name] = stacked
+                clock["slot_write"] = time.perf_counter() - t_asm
 
-            self._dispatch_batch(batch, items, n, b, clock, None)
+                self._dispatch_batch(batch, chunk, n, b, clock, None)
 
     # ------------------------------------------------------ completion
 
@@ -989,7 +1198,14 @@ class BatchEngine:
             self._in_flight.release()
             if done is not None:
                 # bucket compiled + round-tripped: plain watchdog
-                # budget (no first-batch grace) from here on
+                # budget (no first-batch grace) from here on — and a
+                # mid-traffic cold bucket's round-trip IS its compile
+                # (compile-cache accounting; warmup banks warmed
+                # buckets before traffic instead)
+                if done[2] not in self._buckets_done:
+                    self.stats.compiled_programs += 1
+                    self.stats.compile_seconds += (
+                        time.perf_counter() - done[0])
                 self._buckets_done.add(done[2])
             if sealed is not None:
                 # the staging block is free the moment the readback
@@ -1008,11 +1224,23 @@ class BatchEngine:
             metrics.observe("evam_step_seconds", now - t0, {"engine": self.name})
             readback_s = now - t_rb
             t_res = time.perf_counter()
+            # ragged scatter-back: a packed batch's output rows are
+            # unit rows — item i owns host[offset[i] : offset[i] +
+            # row_len[i]] (exactly its real region rows, zero-region
+            # items resolve to an empty slice). Dense batches keep the
+            # one-row-per-item contract.
+            ragged = (sealed is not None and sealed.row_len is not None)
             for i, it in enumerate(items):
                 metrics.observe(
                     "evam_item_latency_seconds", now - it.t_submit, {"engine": self.name}
                 )
-                _safe_set_result(it.future, host[i])
+                if ragged:
+                    off = int(sealed.row_offset[i])
+                    _safe_set_result(
+                        it.future,
+                        host[off:off + int(sealed.row_len[i])])
+                else:
+                    _safe_set_result(it.future, host[i])
             resolve_s = time.perf_counter() - t_res
             self.stats.add_stage("readback", readback_s)
             self.stats.add_stage("resolve", resolve_s)

@@ -10,10 +10,14 @@ default that the TPU design is built around.
 
 from __future__ import annotations
 
+import os
 import threading
+
+import numpy as np
 
 from evam_tpu.engine import steps as step_builders
 from evam_tpu.engine.batcher import BatchEngine
+from evam_tpu.engine.ragged import RaggedSpec, ragged_mode
 from evam_tpu.engine.supervisor import SupervisedEngine
 from evam_tpu.models.registry import LoadedModel, ModelRegistry
 from evam_tpu.obs import get_logger, metrics
@@ -51,6 +55,8 @@ class EngineHub:
         first_batch_grace: float = 10.0,
         sched: SchedConfig | None = None,
         transfer: str | None = None,
+        ragged: str | None = None,
+        ragged_unit_budget: int = 0,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -92,6 +98,19 @@ class EngineHub:
         #: the factory closure carries it, so a supervisor-rebuilt
         #: engine keeps its transfer mode. None = engine reads the env.
         self.transfer = transfer
+        #: ragged batching (engine/ragged.py, EVAM_RAGGED): "packed"
+        #: gives classify-family engines masked region packing (the
+        #: ragged builder + a RaggedSpec'd staging ring) and every
+        #: engine a consolidated bucket ladder; "off" (default) is the
+        #: byte-identical dense path. Part of the rebuild recipe — the
+        #: factory closure carries mode + spec, so supervisor rebuilds
+        #: inherit EVAM_RAGGED.
+        self.ragged = ragged_mode(ragged)
+        #: packed unit rows budgeted per batch row (EVAM_RAGGED_UNIT_
+        #: BUDGET): the knob that turns "roi_budget slots per frame,
+        #: mostly empty" into a shared pool sized for the real mix
+        self.ragged_unit_budget = ragged_unit_budget or int(
+            os.environ.get("EVAM_RAGGED_UNIT_BUDGET", "4"))
         self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
@@ -128,12 +147,18 @@ class EngineHub:
                 builder, input_names, wired = _BUILDERS[kind]
                 if wired:
                     builder_kwargs.setdefault("wire_format", self.wire_format)
+                spec = self._ragged_spec(kind, builder_kwargs)
+                if spec is not None and self.ragged == "packed":
+                    # masked region packing: one fixed-shape program
+                    # over the packed unit block (engine/ragged.py)
+                    builder = step_builders.build_classify_step_ragged
                 step_fn = builder(model, **builder_kwargs)
                 if self.device_synth and wired:
                     step_fn = self._synth_wrap(step_fn, synth_hw, key)
                     self._synth_hw[key] = tuple(synth_hw)
                 self._engines[key] = self._build(
-                    key, step_fn, model.params, input_names)
+                    key, step_fn, model.params, input_names,
+                    ragged_spec=spec)
                 log.info("created engine %s (model %s)", key, model_key)
             elif self.device_synth and synth_hw is not None:
                 self._check_synth_hw(key, synth_hw)
@@ -173,13 +198,30 @@ class EngineHub:
                 self._check_synth_hw(key, synth_hw)
             return self._engines[key]
 
-    def _build(self, key: str, step_fn, params, input_names):
+    def _ragged_spec(self, kind: str, builder_kwargs: dict
+                     ) -> RaggedSpec | None:
+        """Unit-level shape declaration for classify-family engines
+        (the per-item ROI budget the dense path pads to). Attached in
+        BOTH ragged modes so occupancy accounting is honest about
+        interior padding; packing itself is mode-gated."""
+        if kind != "classify":
+            return None
+        budget = int(builder_kwargs.get("roi_budget", 8))
+        return RaggedSpec(
+            input="boxes", unit_shape=(4,), dtype=np.float32,
+            max_units=budget,
+            unit_budget=min(self.ragged_unit_budget, budget),
+        )
+
+    def _build(self, key: str, step_fn, params, input_names,
+               ragged_spec: RaggedSpec | None = None):
         """Construct the engine for ``key`` — as a SupervisedEngine
         (the stable handle whose live BatchEngine a wedge-triggered
         rebuild swaps underneath) unless supervision is disabled. The
         factory closure is the rebuild recipe: a replacement engine
         gets a fresh ``jax.jit`` wrapper and a fresh SlotRing from the
-        same step function and params."""
+        same step function and params (and the same EVAM_RAGGED mode +
+        unit spec — a rebuild must not flip the batch layout)."""
 
         def factory() -> BatchEngine:
             return BatchEngine(
@@ -194,6 +236,8 @@ class EngineHub:
                 first_batch_grace=self.first_batch_grace,
                 sched=self.sched,
                 transfer=self.transfer,
+                ragged=self.ragged,
+                ragged_spec=ragged_spec,
             )
 
         if not self.supervise:
@@ -248,6 +292,20 @@ class EngineHub:
                     # inline — report what actually runs)
                     "transfer": ("pipelined" if getattr(
                         e, "_pipelined", False) else "inline"),
+                    # ragged batching (engine/ragged.py): effective
+                    # mode, the honest units/computed-unit-rows
+                    # occupancy (the pad tax n/bucket hides), where
+                    # traffic lands per program shape, and the
+                    # compile-cache bill bucket consolidation exists
+                    # to shrink
+                    "ragged": getattr(e, "ragged", "off"),
+                    "unit_occupancy": round(e.stats.unit_occupancy, 4),
+                    "bucket_batches": {
+                        str(b): c for b, c in sorted(
+                            e.stats.bucket_batches.items())},
+                    "compiled_programs": e.stats.compiled_programs,
+                    "compile_s": round(e.stats.compile_seconds, 3),
+                    "oversize_splits": e.stats.oversize_splits,
                     # per-batch host clock means (ringbuf.STAGES order)
                     "stage_ms": e.stats.stage_ms_per_batch(),
                     # supervision lifecycle (engine/supervisor.py);
@@ -347,10 +405,28 @@ class EngineHub:
             if self.warmup else len(engines)
         )
         states = [getattr(e, "state", "running") for e in engines]
+        batches = sum(e.stats.batches for e in engines)
         return {
             "engines": len(engines),
             "warmed": warmed,
             "warming": len(engines) - warmed,
+            # occupancy export (engine/ragged.py satellite): the
+            # batch-weighted item fill and the pad-tax-honest unit
+            # fill across every engine — the fleet-level "are we
+            # paying for empty rows" number, scalar so the health
+            # payload keeps a fixed shape (per-bucket batch counts
+            # live on /engines, per-engine gauges on /metrics)
+            "occupancy": round(
+                sum(e.stats.occupancy_sum for e in engines) / batches
+                if batches else 0.0, 4),
+            "unit_occupancy": round(
+                (sum(e.stats.units for e in engines)
+                 / max(1, sum(e.stats.unit_slots for e in engines)))
+                if batches else 0.0, 4),
+            # compile-cache bill across engines (bucket consolidation
+            # drops it; /engines itemizes per engine)
+            "compiled_programs": sum(
+                e.stats.compiled_programs for e in engines),
             # a wedged backend (stall watchdog fired) is a liveness
             # failure, not a warmup phase — monitoring must see it.
             # Supervised engines leave this bucket the moment the

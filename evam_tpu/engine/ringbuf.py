@@ -30,6 +30,17 @@ row copies happen OUTSIDE the lock (each row has exactly one writer),
 and a seal waits for all in-flight writers of that slot. Items resolve
 in row order, so per-batch future fan-out stays positionally correct.
 
+**Ragged packing** (``engine/ragged.py``, ``EVAM_RAGGED=packed``): a
+ring built with a ``RaggedSpec`` additionally packs ONE declared
+input's variable-length unit rows (a frame's real region boxes, shape
+``(k, unit_shape)``) end to end into a fixed unit block, maintaining a
+segment-id vector (``seg[j]`` = owning batch row, −1 on the pad tail)
+and per-item ``row_len``/``row_offset`` vectors the completer uses to
+scatter results back. An item reserves 1 batch row + k unit rows; a
+slot seals when either runs out, so a packed batch never overflows its
+fixed device shape. Everything else — slot reuse, dirty-tail zeroing,
+writer accounting — is the same discipline extended to the unit block.
+
 Measured on this box (``tools/bench_hostpath.py``, serving-default
 bucket 128 at the 432×768 I420 wire shape): 3.1× cheaper than
 stack+concat at full occupancy, 7.5× with a padded tail (legacy pays
@@ -49,6 +60,8 @@ from collections import deque
 from typing import Any
 
 import numpy as np
+
+from evam_tpu.engine.ragged import RaggedSpec
 
 #: stage names of the per-batch host clock, in pipeline order.
 #: submit_wait covers slot backpressure AND the deadline-batching
@@ -74,9 +87,11 @@ class _Slot:
     themselves (single writer per reserved row, written unlocked)."""
 
     __slots__ = ("arrays", "items", "count", "high", "writers",
-                 "t_first", "closed", "wait_sum", "write_sum", "gen")
+                 "t_first", "closed", "wait_sum", "write_sum", "gen",
+                 "unit_count", "unit_high", "row_len", "seg")
 
-    def __init__(self, arrays: dict[str, np.ndarray]):
+    def __init__(self, arrays: dict[str, np.ndarray],
+                 capacity: int = 0, unit_capacity: int = 0):
         self.arrays = arrays
         self.items: list[Any] = []
         self.count = 0
@@ -92,24 +107,46 @@ class _Slot:
         #: that slept through a watchdog drain can detect its claim
         #: went stale instead of double-dispatching the slot
         self.gen = 0
+        #: ragged packing bookkeeping (unused on dense rings)
+        self.unit_count = 0
+        self.unit_high = 0
+        self.row_len = (np.zeros(capacity, np.int32)
+                        if unit_capacity else None)
+        self.seg = (np.full(unit_capacity, -1, np.int32)
+                    if unit_capacity else None)
 
 
 class SealedBatch:
     """A sealed slot ready for dispatch: contiguous ``[:bucket]``
     views over the staging blocks, the items in row order, and the
-    host-clock readings accumulated so far."""
+    host-clock readings accumulated so far.
 
-    __slots__ = ("slot", "arrays", "items", "n", "bucket", "clock")
+    On a ragged ring the batch additionally carries the packed-unit
+    descriptor: ``row_len[i]``/``row_offset[i]`` locate item i's unit
+    rows in the packed block (COPIES — the slot recycles before the
+    completer resolves), ``units`` is the real packed-unit count and
+    ``unit_rows`` the computed unit rows of the device shape (the
+    honest-occupancy denominator)."""
+
+    __slots__ = ("slot", "arrays", "items", "n", "bucket", "clock",
+                 "row_len", "row_offset", "units", "unit_rows")
 
     def __init__(self, slot: _Slot, arrays: dict[str, np.ndarray],
                  items: list, n: int, bucket: int,
-                 clock: dict[str, float]):
+                 clock: dict[str, float],
+                 row_len: np.ndarray | None = None,
+                 row_offset: np.ndarray | None = None,
+                 units: int = 0, unit_rows: int = 0):
         self.slot = slot
         self.arrays = arrays
         self.items = items
         self.n = n
         self.bucket = bucket
         self.clock = clock
+        self.row_len = row_len
+        self.row_offset = row_offset
+        self.units = units
+        self.unit_rows = unit_rows
 
 
 class SlotRing:
@@ -118,13 +155,21 @@ class SlotRing:
     Blocks are allocated lazily on the first ``write()`` (item shapes
     are not known at engine construction) and NEVER reallocated —
     ``blocks_allocated`` is the test hook pinning that invariant.
+
+    ``ragged`` (a RaggedSpec) switches the declared input to packed
+    unit-row staging; its bucket callbacks then take ``(n, units)``
+    instead of ``(n)``.
     """
 
-    def __init__(self, capacity: int, depth: int = 4):
+    def __init__(self, capacity: int, depth: int = 4,
+                 ragged: RaggedSpec | None = None):
         if capacity < 1 or depth < 2:
             raise ValueError("capacity >= 1 and depth >= 2 required")
         self.capacity = capacity
         self.depth = depth
+        self.ragged = ragged
+        #: fixed unit rows of the packed block (0 on dense rings)
+        self.unit_capacity = ragged.unit_rows(capacity) if ragged else 0
         self._cv = threading.Condition()
         self._free: deque[_Slot] = deque()
         self._full: deque[_Slot] = deque()
@@ -140,32 +185,54 @@ class SlotRing:
     def write(self, inputs: dict[str, np.ndarray], item) -> None:
         """Reserve the next row of the open slot and copy ``inputs``
         into it (copy happens outside the ring lock). Blocks while
-        every slot is in flight — natural backpressure. Raises
-        RuntimeError once the ring is closed."""
+        every slot is in flight — natural backpressure. On a ragged
+        ring the item also reserves its ``k`` unit rows; an item that
+        would overflow the open slot's unit block seals that slot and
+        takes the next one. Raises RuntimeError once the ring is
+        closed."""
         arrays = {k: np.asarray(v) for k, v in inputs.items()}
+        spec = self.ragged
+        k = int(arrays[spec.input].shape[0]) if spec is not None else 0
         t0 = time.perf_counter()
         with self._cv:
             if self._shapes is None:
                 self._allocate(arrays)
             else:
                 self._check_shapes(arrays)
-            while (self._open is None and not self._free
-                   and not self._closed):
+            while True:
+                if self._closed:
+                    raise RuntimeError("staging ring is closed")
+                if self._open is not None:
+                    slot = self._open
+                    if (spec is None
+                            or slot.unit_count + k <= self.unit_capacity):
+                        break
+                    # packed units would overflow the fixed block:
+                    # seal what's staged and take a fresh slot
+                    slot.closed = True
+                    self._full.append(slot)
+                    self._open = None
+                    self._cv.notify_all()
+                    continue
+                if self._free:
+                    slot = self._free.popleft()
+                    slot.t_first = time.perf_counter()
+                    self._open = slot
+                    break
                 self._cv.wait(0.1)
-            if self._closed:
-                raise RuntimeError("staging ring is closed")
             waited = time.perf_counter() - t0
-            if self._open is None:
-                slot = self._free.popleft()
-                slot.t_first = time.perf_counter()
-                self._open = slot
-            slot = self._open
             row = slot.count
+            off = slot.unit_count
             slot.count += 1
+            slot.unit_count += k
+            if spec is not None:
+                slot.row_len[row] = k
             slot.writers += 1
             slot.items.append(item)
             slot.wait_sum += waited
-            filled = slot.count >= self.capacity
+            filled = (slot.count >= self.capacity
+                      or (spec is not None
+                          and slot.unit_count >= self.unit_capacity))
             if filled:
                 slot.closed = True
                 self._full.append(slot)
@@ -178,7 +245,12 @@ class SlotRing:
         t1 = time.perf_counter()
         try:
             for name, a in arrays.items():
-                slot.arrays[name][row] = a  # row exclusively owned
+                if spec is not None and name == spec.input:
+                    if k:  # packed span exclusively owned
+                        slot.arrays[name][off:off + k] = a
+                        slot.seg[off:off + k] = row
+                else:
+                    slot.arrays[name][row] = a  # row exclusively owned
         finally:
             with self._cv:
                 slot.write_sum += time.perf_counter() - t1
@@ -192,8 +264,10 @@ class SlotRing:
         """Wait for rows, honor the batch-fill deadline (measured from
         the open slot's FIRST write), then seal: close the slot, wait
         out in-flight row writers, zero the dirty pad tail, and return
-        contiguous ``[:bucket]`` views. Returns None once the ring is
-        closed and drained."""
+        contiguous ``[:bucket]`` views. On a ragged ring ``bucket_fn``
+        is called with ``(n, units)`` and the packed block/seg tail is
+        masked too. Returns None once the ring is closed and
+        drained."""
         with self._cv:
             while True:
                 if self._full:
@@ -243,18 +317,54 @@ class SlotRing:
                 write_sum = slot.write_sum
                 break
         t0 = time.perf_counter()
+        sealed = self._seal(slot, items, n, bucket_fn)
+        sealed.clock.update({
+            "submit_wait": submit_wait,
+            "slot_write": write_sum,
+        })
+        sealed.clock["seal"] = time.perf_counter() - t0
+        return sealed
+
+    def _seal(self, slot: _Slot, items: list, n: int,
+              bucket_fn) -> SealedBatch:
+        """Common seal tail (deadline path + stage_direct): pick the
+        bucket, zero the dirty pad tails (dense rows AND, on a ragged
+        ring, the packed unit block + seg vector), and build the
+        contiguous views + ragged descriptor."""
+        spec = self.ragged
+        if spec is not None:
+            units = slot.unit_count
+            bucket = bucket_fn(n, units)
+            u = min(spec.unit_rows(bucket), self.unit_capacity)
+            dirty = min(slot.high, bucket)
+            views: dict[str, np.ndarray] = {}
+            for name, arr in slot.arrays.items():
+                if name == spec.input:
+                    udirty = min(slot.unit_high, u)
+                    if udirty > units:
+                        arr[units:udirty] = 0
+                    views[name] = arr[:u]
+                else:
+                    if dirty > n:
+                        arr[n:dirty] = 0
+                    views[name] = arr[:bucket]
+            # the seg pad tail is ALWAYS −1 (the masked-compute
+            # sentinel), whatever an earlier batch left behind
+            slot.seg[units:u] = -1
+            views["seg"] = slot.seg[:u]
+            row_len = slot.row_len[:n].copy()
+            row_offset = np.zeros(n, np.int32)
+            np.cumsum(row_len[:-1], out=row_offset[1:])
+            return SealedBatch(slot, views, items, n, bucket, {},
+                               row_len=row_len, row_offset=row_offset,
+                               units=units, unit_rows=u)
         bucket = bucket_fn(n)
         dirty = min(slot.high, bucket)
         for arr in slot.arrays.values():
             if dirty > n:
                 arr[n:dirty] = 0
         views = {k: a[:bucket] for k, a in slot.arrays.items()}
-        clock = {
-            "submit_wait": submit_wait,
-            "slot_write": write_sum,
-            "seal": time.perf_counter() - t0,
-        }
-        return SealedBatch(slot, views, items, n, bucket, clock)
+        return SealedBatch(slot, views, items, n, bucket, {})
 
     # ------------------------------------------------------- completion
 
@@ -268,6 +378,10 @@ class SlotRing:
             # bucket may still hold older data
             if slot.high <= sealed.bucket:
                 slot.high = sealed.n
+            if self.ragged is not None:
+                if slot.unit_high <= sealed.unit_rows:
+                    slot.unit_high = sealed.units
+                slot.unit_count = 0
             slot.count = 0
             slot.items = []
             slot.closed = False
@@ -303,6 +417,9 @@ class SlotRing:
                 out.extend(slot.items)
                 slot.high = max(slot.high, slot.count)
                 slot.count = 0
+                if self.ragged is not None:
+                    slot.unit_high = max(slot.unit_high, slot.unit_count)
+                    slot.unit_count = 0
                 slot.items = []
                 slot.closed = False
                 slot.wait_sum = 0.0
@@ -336,7 +453,8 @@ class SlotRing:
     # ------------------------------------------- dispatcher-side staging
 
     def stage_direct(self, staged: list[tuple[dict, Any]], bucket_fn,
-                     clock: dict[str, float]) -> SealedBatch | None:
+                     clock: dict[str, float],
+                     ) -> tuple[SealedBatch | None, list]:
         """Stage a dispatcher-assembled batch into a free slot (the
         sched path: items arrive from per-class queues, so the row
         copies happen HERE on the dispatcher thread instead of on the
@@ -345,11 +463,16 @@ class SlotRing:
 
         ``staged`` is ``[(inputs, item), ...]`` in dispatch order. A
         row whose arrays mismatch the ring shapes fails only ITS
-        item's future; survivors compact into contiguous rows. Blocks
-        while every slot is in flight (the same host-side
-        backpressure as the submit path); raises RuntimeError once
-        the ring is closed; returns None when no row survived."""
+        item's future; survivors compact into contiguous rows. Items
+        past the slot's capacity — batch rows, or packed unit rows on
+        a ragged ring — are NOT silently clamped: they come back as
+        the second element for the caller to stage as another batch
+        (the oversize-split contract). Blocks while every slot is in
+        flight (the same host-side backpressure as the submit path);
+        raises RuntimeError once the ring is closed; the sealed batch
+        is None when no row survived."""
         first = {k: np.asarray(v) for k, v in staged[0][0].items()}
+        spec = self.ragged
         with self._cv:
             if self._shapes is None:
                 self._allocate(first)
@@ -360,60 +483,99 @@ class SlotRing:
             slot = self._free.popleft()
         t0 = time.perf_counter()
         ok_items: list = []
+        remaining: list = []
         row = 0
-        for inputs, item in staged:
+        off = 0
+        for idx, (inputs, item) in enumerate(staged):
+            if row >= self.capacity:
+                remaining = list(staged[idx:])
+                break
             try:
                 arrays = {k: np.asarray(v) for k, v in inputs.items()}
                 self._check_shapes(arrays)
-                for name, a in arrays.items():
-                    slot.arrays[name][row] = a
             except Exception as exc:  # noqa: BLE001 — fail only this item
                 try:
                     item.future.set_exception(exc)
                 except Exception:  # noqa: BLE001 — already resolved
                     pass
                 continue
+            if spec is not None:
+                k = int(arrays[spec.input].shape[0])
+                if off + k > self.unit_capacity:
+                    remaining = list(staged[idx:])
+                    break
+                for name, a in arrays.items():
+                    if name == spec.input:
+                        if k:
+                            slot.arrays[name][off:off + k] = a
+                            slot.seg[off:off + k] = row
+                    else:
+                        slot.arrays[name][row] = a
+                slot.row_len[row] = k
+                off += k
+            else:
+                for name, a in arrays.items():
+                    slot.arrays[name][row] = a
             ok_items.append(item)
             row += 1
         clock["slot_write"] = time.perf_counter() - t0
         if not ok_items:
             with self._cv:
                 slot.count = 0
+                slot.unit_count = 0
                 slot.items = []
                 slot.closed = False
                 slot.gen += 1
                 self._free.append(slot)
                 self._cv.notify_all()
-            return None
+            return None, remaining
         t1 = time.perf_counter()
-        n = row
-        bucket = bucket_fn(n)
-        dirty = min(slot.high, bucket)
-        for arr in slot.arrays.values():
-            if dirty > n:
-                arr[n:dirty] = 0
-        views = {k: a[:bucket] for k, a in slot.arrays.items()}
-        clock["seal"] = time.perf_counter() - t1
-        slot.count = n
-        return SealedBatch(slot, views, ok_items, n, bucket, clock)
+        slot.count = row
+        slot.unit_count = off
+        sealed = self._seal(slot, ok_items, row, bucket_fn)
+        sealed.clock.update(clock)
+        sealed.clock["seal"] = time.perf_counter() - t1
+        return sealed, remaining
 
     # -------------------------------------------------------- internals
 
     def _allocate(self, example: dict[str, np.ndarray]) -> None:
-        self._shapes = {
-            k: (tuple(a.shape), a.dtype) for k, a in example.items()
-        }
+        spec = self.ragged
+        self._shapes = {}
+        for k, a in example.items():
+            if spec is not None and k == spec.input:
+                # ragged input: the leading dim is per-item variable;
+                # pin only the unit shape + dtype
+                self._shapes[k] = (tuple(spec.unit_shape),
+                                   np.dtype(spec.dtype))
+            else:
+                self._shapes[k] = (tuple(a.shape), a.dtype)
         for _ in range(self.depth):
-            arrays = {
-                k: np.zeros((self.capacity,) + shape, dtype)
-                for k, (shape, dtype) in self._shapes.items()
-            }
+            arrays = {}
+            for k, (shape, dtype) in self._shapes.items():
+                rows = (self.unit_capacity
+                        if spec is not None and k == spec.input
+                        else self.capacity)
+                arrays[k] = np.zeros((rows,) + shape, dtype)
             self.blocks_allocated += len(arrays)
-            self._free.append(_Slot(arrays))
+            self._free.append(
+                _Slot(arrays, capacity=self.capacity,
+                      unit_capacity=self.unit_capacity))
 
     def _check_shapes(self, arrays: dict[str, np.ndarray]) -> None:
+        spec = self.ragged
         for k, a in arrays.items():
             want = self._shapes.get(k)
+            if spec is not None and k == spec.input:
+                if (want is None
+                        or (tuple(a.shape[1:]), a.dtype) != want
+                        or a.shape[0] > spec.max_units):
+                    raise ValueError(
+                        f"ragged input {k}: want (<= {spec.max_units}, "
+                        f"{want[0] if want else '?'}) "
+                        f"{want[1] if want else '?'}, got shape "
+                        f"{tuple(a.shape)} dtype {a.dtype}")
+                continue
             if want is None or (tuple(a.shape), a.dtype) != want:
                 raise ValueError(
                     f"staging ring configured for {self._shapes}, got "

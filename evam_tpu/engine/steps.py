@@ -287,6 +287,55 @@ def build_classify_step(
     return step
 
 
+def build_classify_step_ragged(
+    model: LoadedModel, roi_budget: int = 8, wire_format: str = "bgr"
+) -> Callable:
+    """Packed-ragged classify (EVAM_RAGGED=packed, engine/ragged.py):
+    frames + a PACKED box block + segment ids → per-unit head probs.
+
+    The dense step (`build_classify_step`) computes ``B × roi_budget``
+    ROI crops whatever the frames' real region counts — on the
+    serving mix most of those unit rows are per-item zero-pad (the
+    invisible half of the pad tax). Here the staging ring packs every
+    frame's REAL boxes end to end: ``boxes`` is ``[U, 4]``, ``seg[j]``
+    names the batch row that owns packed unit j (−1 on the pad tail),
+    and the step computes exactly the packed block — one fixed-shape
+    program for every fill level, Ragged Paged Attention style
+    (PAPERS.md).
+
+    Masked compute: pad rows gather a clamped (valid) frame index so
+    the program stays branch-free, and their outputs are zeroed by
+    the validity mask. Real rows multiply by exactly 1.0, so a unit's
+    output is bit-identical to the dense step's row for the same
+    (frame, box) pair — the EVAM_RAGGED A/B contract. Output
+    ``[U, total_classes]``; the completer scatters rows back per item
+    via the sealed batch's row_len/row_offset.
+    """
+    preproc = model.preprocess
+    forward = model.forward
+
+    def step(params, frames, boxes, seg):
+        u = boxes.shape[0]
+        valid = seg >= 0
+        src = jnp.clip(seg, 0, frames.shape[0] - 1)
+        f = jnp.take(frames, src, axis=0)  # [U, wire...]
+        if wire_format == "i420":
+            crops = crop_rois_i420(
+                f, boxes[:, None, :], (preproc.height, preproc.width))
+        else:
+            crops = crop_rois(
+                decode_wire(f, wire_format), boxes[:, None, :],
+                (preproc.height, preproc.width))
+        crops = crops.reshape((u,) + crops.shape[2:])
+        x = preprocess_bgr(crops, preproc)
+        out = forward(params, x)  # dict head -> [U, n]
+        probs = [_head_probs(model, name, out) for name, _ in model.spec.heads]
+        packed = jnp.concatenate(probs, axis=-1)
+        return packed * valid[:, None].astype(packed.dtype)
+
+    return step
+
+
 def build_action_encode_step(
     model: LoadedModel, wire_format: str = "bgr"
 ) -> Callable:

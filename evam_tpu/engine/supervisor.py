@@ -107,7 +107,8 @@ class SupervisedEngine:
 
     # ------------------------------------------------------------- API
 
-    def submit(self, priority: str = "standard", **inputs) -> Future:
+    def submit(self, priority: str = "standard",
+               units: int | None = None, **inputs) -> Future:
         with self._lock:
             state = self.state
             eng = self._engine
@@ -126,7 +127,7 @@ class SupervisedEngine:
                 f"engine {self.name} is restarting after a wedge; "
                 "retry shortly"
             )
-        return eng.submit(priority=priority, **inputs)
+        return eng.submit(priority=priority, units=units, **inputs)
 
     def warm_async(self, **example) -> None:
         with self._lock:
@@ -167,14 +168,9 @@ class SupervisedEngine:
             carry = self._stats_carry
             if carry is None:
                 return live
-            merged = EngineStats(
-                batches=carry.batches + live.batches,
-                items=carry.items + live.items,
-                occupancy_sum=carry.occupancy_sum + live.occupancy_sum,
-                stage_seconds=dict(carry.stage_seconds),
-            )
-        for k, v in live.stage_seconds.items():
-            merged.stage_seconds[k] = merged.stage_seconds.get(k, 0.0) + v
+            merged = EngineStats()
+            merged.absorb(carry)
+        merged.absorb(live)
         return merged
 
     def shed_counts(self) -> dict[str, int]:
@@ -203,12 +199,11 @@ class SupervisedEngine:
                 self._shed_carry[c] = self._shed_carry.get(c, 0) + n
             if self._stats_carry is None:
                 self._stats_carry = EngineStats()
-            sc = self._stats_carry
-            sc.batches += live.batches
-            sc.items += live.items
-            sc.occupancy_sum += live.occupancy_sum
-            for k, v in live.stage_seconds.items():
-                sc.stage_seconds[k] = sc.stage_seconds.get(k, 0.0) + v
+            # absorb() covers the full counter surface (items, unit
+            # occupancy, bucket counts, compile-cache bill, oversize
+            # splits) so /engines and the bench line stay monotonic
+            # across rebuilds for the new fields too
+            self._stats_carry.absorb(live)
 
     # ------------------------------------------------------ delegation
 

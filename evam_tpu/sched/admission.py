@@ -173,14 +173,27 @@ class AdmissionController:
             return self.cfg.capacity_fps
         caps = []
         for stats in self.hub.stats().values():
-            if not stats.get("batches"):
+            batches = stats.get("batches")
+            if not batches:
                 continue
             stage_ms = stats.get("stage_ms") or {}
             service_ms = sum(stage_ms.get(s, 0.0) for s in _SERVICE_STAGES)
             if service_ms <= 0:
                 continue
-            occ = max(float(stats.get("mean_occupancy", 0.0)), 1e-3)
-            caps.append((1e3 / service_ms) * occ * self.hub.max_batch)
+            # honest occupancy (the ragged-batching satellite): real
+            # items per dispatched batch, straight from the engine
+            # counters. The old mean_occupancy × top-bucket projection
+            # overstated capacity whenever traffic landed in small
+            # buckets (a FULL bucket-4 batch read as occupancy 1.0 of
+            # the 128-slot shape). Stats rows without an item count
+            # (declared/faked hubs) keep the legacy projection.
+            items = stats.get("items")
+            if items:
+                per_batch = items / batches
+            else:
+                occ = max(float(stats.get("mean_occupancy", 0.0)), 1e-3)
+                per_batch = occ * self.hub.max_batch
+            caps.append((1e3 / service_ms) * per_batch)
         return min(caps) if caps else 0.0
 
     def utilization(self) -> float:

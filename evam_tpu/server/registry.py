@@ -69,6 +69,8 @@ class PipelineRegistry:
                 first_batch_grace=settings.tpu.first_batch_grace,
                 sched=sched_cfg if sched_cfg.enabled else None,
                 transfer=settings.tpu.transfer,
+                ragged=settings.tpu.ragged,
+                ragged_unit_budget=settings.tpu.ragged_unit_budget,
             )
         self.hub = hub
         #: QoS layer (evam_tpu/sched/): the hub's sched config is the

@@ -242,6 +242,11 @@ class ClassifyStage(AsyncStage):
             roi_budget=self.ROI_BUDGET,
             synth_wire_hw=self.ingest_size,
         )
+        #: packed-ragged engine (EVAM_RAGGED=packed, engine/ragged.py):
+        #: submit the frame's REAL region boxes — shape (k, 4) — and
+        #: let the staging ring pack them across the batch, instead of
+        #: zero-padding every frame to the ROI budget
+        self._packed = getattr(self.engine, "ragged", "off") == "packed"
         _warm_engine(
             hub, self.engine, self.ingest_size, self.wire,
             boxes=np.zeros((self.ROI_BUDGET, 4), np.float32),
@@ -262,11 +267,17 @@ class ClassifyStage(AsyncStage):
         regions = self._eligible(ctx)
         if not regions:
             return None
-        boxes = np.zeros((self.ROI_BUDGET, 4), np.float32)
+        # packed: exactly the frame's region rows (the ring packs them
+        # across the batch); dense: the fixed ROI-budget pad block.
+        # ``units`` keeps the engine's occupancy accounting honest
+        # about interior padding on BOTH paths.
+        rows = len(regions) if self._packed else self.ROI_BUDGET
+        boxes = np.zeros((rows, 4), np.float32)
         for i, r in enumerate(regions):
             boxes[i] = [r.x0, r.y0, r.x1, r.y1]
         return self.engine.submit(
             priority=ctx.priority,
+            units=len(regions),
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire),
             boxes=boxes)
 

@@ -84,6 +84,10 @@ def test_bench_serialize_compile_serve_emits_contract_line():
         assert set(data[key]) == {"realtime", "standard", "batch"}, key
     assert data["sched_admitted"]["standard"] == 2
     assert sum(data["sched_rejected"].values()) == 0
+    # compile-cache accounting rides the line (engine/ragged.py):
+    # every bucket program this run compiled, the number bucket
+    # consolidation (EVAM_RAGGED=packed) is measured against
+    assert data["compiled_programs"] >= 1
     # content-adaptive gating outcome rides the line too
     # (stages/gate.py): this run is ungated — the A/B baseline shape
     # is all-zero counts, fixed keys

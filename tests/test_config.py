@@ -20,6 +20,8 @@ def test_settings_from_env(monkeypatch):
     monkeypatch.setenv("EVAM_PRELOAD", "all")
     monkeypatch.setenv("EVAM_STALL_TIMEOUT_S", "45.5")
     monkeypatch.setenv("EVAM_PRECISION", "int8")
+    monkeypatch.setenv("EVAM_RAGGED", "packed")
+    monkeypatch.setenv("EVAM_RAGGED_UNIT_BUDGET", "3")
     s = Settings.from_env()
     assert s.run_mode == "EII"
     assert s.detection_device == "cpu"
@@ -28,6 +30,14 @@ def test_settings_from_env(monkeypatch):
     assert s.preload == "all"
     assert s.tpu.stall_timeout_s == 45.5
     assert s.tpu.precision == "int8"
+    assert s.tpu.ragged == "packed"
+    assert s.tpu.ragged_unit_budget == 3
+
+
+def test_settings_ragged_default_off():
+    # EVAM_RAGGED=off stays the serving default until a TPU window
+    # banks packed-vs-bucketed numbers (ROADMAP)
+    assert Settings().tpu.ragged == "off"
 
 
 def test_settings_file_then_env_override(tmp_path, monkeypatch):
