@@ -416,6 +416,10 @@ def run_serve_bench(args) -> dict:
         from evam_tpu.stages.gate import registry as gate_registry
 
         gate_summary = gate_registry.summary()
+        # fleet operating point (evam_tpu/fleet/): fixed shape whether
+        # EVAM_FLEET is off (mode=off, zeros) or sharded — the
+        # contract line pins the keys either way
+        fleet_summary = reg.hub.fleet_summary()
         demux_stats = (reg.rtsp_demux.stats()
                        if reg.rtsp_demux is not None else None)
     finally:
@@ -459,6 +463,7 @@ def run_serve_bench(args) -> dict:
         "sched_rejected": sched_counts["rejected"],
         "sched_shed": sched_shed,
         "gate": gate_summary,
+        "fleet": fleet_summary,
         **({"demux": demux_stats} if demux_stats else {}),
     }
 

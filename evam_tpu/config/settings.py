@@ -84,6 +84,22 @@ class TPUSettings(BaseModel):
     #: a packed classify batch carries per frame ON AVERAGE; floored
     #: at the stage ROI budget so a lone full frame always fits)
     ragged_unit_budget: int = 4
+    #: fleet serving mode (evam_tpu/fleet/): "sharded" serves every
+    #: engine key as one per-chip shard per mesh device behind a
+    #: consistent-hash stream placer (small buckets, no collectives)
+    #: plus one mesh-sharded twin for batch-class big buckets, with
+    #: fleet-wide Σ-shard admission capacity and drain-and-rebalance
+    #: on shard degradation; "off" (default) keeps the single-chip
+    #: path byte-identical for A/B (tools/bench_fleet.py), the same
+    #: discipline as EVAM_TRANSFER / EVAM_GATE / EVAM_RAGGED.
+    fleet: Literal["sharded", "off"] = "off"
+    #: fleet only: restrict sharding to the first N mesh devices
+    #: (0 = all) — the bench/canary knob for scaling curves
+    fleet_shards: int = 0
+    #: fleet only: per-shard bucket-ladder top (0 = max_batch / shard
+    #: count) — a chip serving 1/N of the streams doesn't need the
+    #: fleet-wide max_batch worth of compile bill and staging memory
+    fleet_shard_max_batch: int = 0
 
 
 class SchedSettings(BaseModel):
@@ -209,6 +225,9 @@ class Settings(BaseModel):
             "EVAM_TRANSFER": ("transfer", str),
             "EVAM_RAGGED": ("ragged", str),
             "EVAM_RAGGED_UNIT_BUDGET": ("ragged_unit_budget", int),
+            "EVAM_FLEET": ("fleet", str),
+            "EVAM_FLEET_SHARDS": ("fleet_shards", int),
+            "EVAM_FLEET_SHARD_MAX_BATCH": ("fleet_shard_max_batch", int),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():

@@ -218,6 +218,7 @@ class BatchEngine:
         transfer: str | None = None,
         ragged: str | None = None,
         ragged_spec: RaggedSpec | None = None,
+        fleet_local: bool = False,
     ):
         self.name = name
         self.plan = plan
@@ -337,8 +338,25 @@ class BatchEngine:
         if self.ragged == "packed":
             # bucket consolidation (engine/ragged.py): adjacent shape
             # buckets share a program instead of each paying compile +
-            # program memory + a cold first-batch stall
-            self.buckets = consolidate_buckets(self.buckets)
+            # program memory + a cold first-batch stall. Rungs are
+            # aligned to the data-axis size at BUILD time so sharded
+            # dispatch never re-pads a sealed block per batch.
+            self.buckets = consolidate_buckets(self.buckets, align=d)
+        #: fleet mode's per-batch collective bypass (evam_tpu/fleet/):
+        #: sub-data-size rungs are added to the ladder and dispatched
+        #: through a second, single-device jit of the SAME step — a
+        #: lightly-filled bucket on the mesh engine runs on one chip
+        #: instead of paying an 8-way collective for 2 real rows. The
+        #: existing bucket fn does the selection (_exec_for); off
+        #: (default) leaves ladder and dispatch byte-identical.
+        self._fleet_local = bool(fleet_local and plan is not None
+                                 and plan.data_size > 1)
+        if self._fleet_local:
+            sub, s = [], 1
+            while s < d:
+                sub.append(s)
+                s *= 2
+            self.buckets = sub + self.buckets
 
         #: staging ring: blocks sized to the LARGEST bucket so a
         #: sealed batch is always a contiguous [:bucket] prefix view;
@@ -389,6 +407,25 @@ class BatchEngine:
         else:
             self._params = params
             self._jit_step = jax.jit(step_fn, donate_argnums=donate)
+        if self._fleet_local:
+            # single-device twin of the sharded step for the sub-data
+            # rungs: params replicated onto (i.e. copied to) the first
+            # mesh device, batch axis "sharded" over a 1-device mesh —
+            # XLA emits no collectives for it
+            self._local_plan = plan.per_device_plans()[0]
+            self._params_local = jax.device_put(
+                params, self._local_plan.replicated())
+            self._jit_step_local = jax.jit(
+                step_fn,
+                in_shardings=(
+                    self._local_plan.replicated(),
+                    *([self._local_plan.batch_sharding()]
+                      * len(self._step_inputs)),
+                ),
+                donate_argnums=donate,
+            )
+        else:
+            self._local_plan = None
 
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._done: queue.Queue[tuple | None] = queue.Queue()
@@ -452,6 +489,7 @@ class BatchEngine:
 
     def submit(self, priority: str = DEFAULT_PRIORITY,
                units: int | None = None,
+               stream: str | None = None,
                **inputs: np.ndarray) -> Future:
         """Enqueue one item (no batch dim); resolves to its packed row(s).
 
@@ -459,6 +497,13 @@ class BatchEngine:
         batch) when the engine runs the QoS layer (evam_tpu/sched/);
         without it the argument is accepted and ignored — the legacy
         single-FIFO path stays byte-identical.
+
+        ``stream`` is the submitting stream's identity. A single-chip
+        engine accepts and ignores it (byte-identical legacy path) —
+        it exists so the fleet mode (evam_tpu/fleet/) can pin a
+        stream's traffic to a per-chip shard; stages pass it
+        unconditionally and the engine kind behind the hub decides
+        whether placement applies.
 
         ``units`` is honest-occupancy metadata: the item's REAL unit
         rows (a frame's region count on classify engines, where the
@@ -763,6 +808,19 @@ class BatchEngine:
         self._count_oversize_split(len(chunks) - 1)
         return chunks
 
+    def _exec_for(self, b: int):
+        """(jit, params, sharding) for one sealed bucket. With the
+        fleet mode's local bypass, sub-data-size buckets select the
+        single-device twin — the existing bucket fn already routed the
+        batch to rung ``b``, so this is the per-batch choice the fleet
+        contract names: small batches never pay a collective."""
+        if self._fleet_local and 0 < b < self.plan.data_size:
+            return (self._jit_step_local, self._params_local,
+                    self._local_plan.batch_sharding())
+        if self.plan is not None:
+            return self._jit_step, self._params, self.plan.batch_sharding()
+        return self._jit_step, self._params, None
+
     def _run(self, batch: dict[str, np.ndarray],
              clock: dict[str, float] | None = None):
         """Inline transfer path (EVAM_TRANSFER=inline, warmup, and the
@@ -782,14 +840,16 @@ class BatchEngine:
         # device RPC — the wedge-proof measurement mode
         with devlock.device_call(f"{self.name}:launch"):
             t0 = time.perf_counter()
+            jit_fn, prm, sharding = self._exec_for(
+                batch[self.input_names[0]].shape[0])
             arrays = []
             for name in self._step_inputs:
                 a = batch[name]
-                if self.plan is not None:
-                    a = jax.device_put(a, self.plan.batch_sharding())
+                if sharding is not None:
+                    a = jax.device_put(a, sharding)
                 arrays.append(a)
             t1 = time.perf_counter()
-            out = self._jit_step(self._params, *arrays)
+            out = jit_fn(prm, *arrays)
             if clock is not None:
                 clock["h2d_issue"] = t1 - t0
                 clock["h2d_wait"] = 0.0
@@ -882,10 +942,10 @@ class BatchEngine:
         try:
             with devlock.device_call(f"{self.name}:h2d"):
                 t0 = time.perf_counter()
-                if self.plan is not None:
+                _, _, sharding = self._exec_for(b)
+                if sharding is not None:
                     # sharded placement is semantics, not an
                     # optimization — always explicit
-                    sharding = self.plan.batch_sharding()
                     dev = [jax.device_put(batch[name], sharding)
                            for name in self._step_inputs]
                 elif self._device_streams:
@@ -920,7 +980,7 @@ class BatchEngine:
                         self._ring.release(sealed)
                     return
 
-    def _launch(self, dev: list, clock: dict[str, float]):
+    def _launch(self, dev: list, clock: dict[str, float], b: int = 0):
         """Launcher half of the pipelined transfer: wait out the head
         batch's H2D residual where that is measurable without
         re-serializing (``_h2d_sync`` — h2d_wait is ≈0 when the upload
@@ -938,7 +998,8 @@ class BatchEngine:
             if self._device_streams:
                 jax.block_until_ready(dev)
             t1 = time.perf_counter()
-            out = self._jit_step(self._params, *dev)
+            jit_fn, prm, _ = self._exec_for(b)
+            out = jit_fn(prm, *dev)
             t2 = time.perf_counter()
             clock["h2d_wait"] = t1 - t0
             clock["launch"] = t2 - t1
@@ -977,7 +1038,7 @@ class BatchEngine:
             t0 = time.perf_counter()
             bid = self._track_dispatch(t0, items, b)
             try:
-                out = self._launch(dev, clock)
+                out = self._launch(dev, clock, b)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
                 self._in_flight.release()
                 with self._exec_lock:

@@ -57,6 +57,8 @@ class EngineHub:
         transfer: str | None = None,
         ragged: str | None = None,
         ragged_unit_budget: int = 0,
+        fleet: str | None = None,
+        fleet_shard_max_batch: int = 0,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -111,6 +113,29 @@ class EngineHub:
         #: mostly empty" into a shared pool sized for the real mix
         self.ragged_unit_budget = ragged_unit_budget or int(
             os.environ.get("EVAM_RAGGED_UNIT_BUDGET", "4"))
+        #: fleet serving mode (evam_tpu/fleet/, EVAM_FLEET): "sharded"
+        #: fronts every engine key with a FleetEngine — one per-chip
+        #: shard per mesh device behind a consistent-hash stream
+        #: placer, plus a mesh-sharded twin for batch-class big
+        #: buckets; "off" (default) is the byte-identical single-chip
+        #: path. Needs a multi-device plan — on one device the modes
+        #: are the same thing, so sharded quietly degrades to off.
+        from evam_tpu.fleet.engine import fleet_mode
+        self.fleet = fleet_mode(fleet)
+        self.fleet_active = (
+            self.fleet == "sharded" and plan is not None
+            and plan.data_size > 1)
+        if self.fleet == "sharded" and not self.fleet_active:
+            log.warning(
+                "EVAM_FLEET=sharded needs a multi-device mesh plan "
+                "(have %s) — running single-chip",
+                plan.data_size if plan else "none")
+        #: per-shard ladder top: a chip serving 1/N of the streams
+        #: does not need the fleet-wide max_batch — capping it keeps
+        #: shard compile bills and staging memory proportional
+        self.fleet_shard_max_batch = fleet_shard_max_batch or (
+            max(1, max_batch // plan.data_size) if self.fleet_active
+            else max_batch)
         self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
@@ -221,32 +246,52 @@ class EngineHub:
         factory closure is the rebuild recipe: a replacement engine
         gets a fresh ``jax.jit`` wrapper and a fresh SlotRing from the
         same step function and params (and the same EVAM_RAGGED mode +
-        unit spec — a rebuild must not flip the batch layout)."""
+        unit spec — a rebuild must not flip the batch layout).
 
-        def factory() -> BatchEngine:
-            return BatchEngine(
-                name=key,
-                step_fn=step_fn,
-                params=params,
-                plan=self.plan,
-                max_batch=self.max_batch,
-                deadline_ms=self.deadline_ms,
-                input_names=input_names,
-                stall_timeout_s=self.stall_timeout_s,
-                first_batch_grace=self.first_batch_grace,
-                sched=self.sched,
-                transfer=self.transfer,
-                ragged=self.ragged,
-                ragged_spec=ragged_spec,
+        Fleet mode builds the same recipe once per mesh device
+        (single-device plan, shard-capped ladder) behind a FleetEngine
+        plus one full-mesh twin for the batch-class big buckets — each
+        shard individually supervised, so a wedge on one chip is that
+        shard's quarantine, not the fleet's."""
+
+        def make(plan, name, max_batch, fleet_local=False):
+            def factory() -> BatchEngine:
+                return BatchEngine(
+                    name=name,
+                    step_fn=step_fn,
+                    params=params,
+                    plan=plan,
+                    max_batch=max_batch,
+                    deadline_ms=self.deadline_ms,
+                    input_names=input_names,
+                    stall_timeout_s=self.stall_timeout_s,
+                    first_batch_grace=self.first_batch_grace,
+                    sched=self.sched,
+                    transfer=self.transfer,
+                    ragged=self.ragged,
+                    ragged_spec=ragged_spec,
+                    fleet_local=fleet_local,
+                )
+
+            if not self.supervise:
+                return factory()
+            return SupervisedEngine(
+                name, factory,
+                max_restarts=self.max_restarts,
+                restart_window_s=self.restart_window_s,
+                backoff_s=self.restart_backoff_s,
             )
 
-        if not self.supervise:
-            return factory()
-        return SupervisedEngine(
-            key, factory,
-            max_restarts=self.max_restarts,
-            restart_window_s=self.restart_window_s,
-            backoff_s=self.restart_backoff_s,
+        if not self.fleet_active:
+            return make(self.plan, key, self.max_batch)
+        from evam_tpu.fleet.engine import FleetEngine
+        return FleetEngine(
+            key,
+            shard_factory=lambda plan, label: make(
+                plan, label, self.fleet_shard_max_batch),
+            plans=self.plan.per_device_plans(),
+            mesh_factory=lambda label: make(
+                self.plan, label, self.max_batch, fleet_local=True),
         )
 
     def _check_synth_hw(self, key: str, synth_hw) -> None:
@@ -278,51 +323,72 @@ class EngineHub:
         return step_builders.wrap_device_synth(
             step_fn, wire_shape(self.wire_format, h, w))
 
+    @staticmethod
+    def _stat_row(e, shard: str | None, device: str | None,
+                  group: str) -> dict:
+        return {
+            "batches": e.stats.batches,
+            "items": e.stats.items,
+            "mean_occupancy": e.stats.mean_occupancy,
+            "warmed": e.warmed.is_set(),
+            "assembly": e.assembly,
+            # effective device-transfer mode (EVAM_TRANSFER;
+            # devlock may have forced a pipelined request to
+            # inline — report what actually runs)
+            "transfer": ("pipelined" if getattr(
+                e, "_pipelined", False) else "inline"),
+            # ragged batching (engine/ragged.py): effective
+            # mode, the honest units/computed-unit-rows
+            # occupancy (the pad tax n/bucket hides), where
+            # traffic lands per program shape, and the
+            # compile-cache bill bucket consolidation exists
+            # to shrink
+            "ragged": getattr(e, "ragged", "off"),
+            "unit_occupancy": round(e.stats.unit_occupancy, 4),
+            "bucket_batches": {
+                str(b): c for b, c in sorted(
+                    e.stats.bucket_batches.items())},
+            "compiled_programs": e.stats.compiled_programs,
+            "compile_s": round(e.stats.compile_seconds, 3),
+            "oversize_splits": e.stats.oversize_splits,
+            # per-batch host clock means (ringbuf.STAGES order)
+            "stage_ms": e.stats.stage_ms_per_batch(),
+            # supervision lifecycle (engine/supervisor.py);
+            # unsupervised raw engines report a static running
+            "state": getattr(e, "state", "running"),
+            "restarts": getattr(e, "restarts", 0),
+            "last_stall_ts": getattr(e, "last_stall_ts", None),
+            # submit-queue visibility (sched satellite): the
+            # backlog that used to be invisible until the
+            # stall watchdog tripped
+            "queue_depth": e.queue_depth(),
+            "queue_age_s": round(e.queue_age_s(), 3),
+            # per-class depths when the QoS layer is on
+            "sched_queues": e.class_depths(),
+            # fleet placement (evam_tpu/fleet/): which chip this row
+            # is, and the engine key it aggregates under — admission
+            # sums capacity per group (Σ shards) instead of treating
+            # every shard as an independent bottleneck
+            "shard": shard,
+            "device": device,
+            "group": group,
+        }
+
     def stats(self) -> dict[str, dict]:
         with self._lock:
-            return {
-                k: {
-                    "batches": e.stats.batches,
-                    "items": e.stats.items,
-                    "mean_occupancy": e.stats.mean_occupancy,
-                    "warmed": e.warmed.is_set(),
-                    "assembly": e.assembly,
-                    # effective device-transfer mode (EVAM_TRANSFER;
-                    # devlock may have forced a pipelined request to
-                    # inline — report what actually runs)
-                    "transfer": ("pipelined" if getattr(
-                        e, "_pipelined", False) else "inline"),
-                    # ragged batching (engine/ragged.py): effective
-                    # mode, the honest units/computed-unit-rows
-                    # occupancy (the pad tax n/bucket hides), where
-                    # traffic lands per program shape, and the
-                    # compile-cache bill bucket consolidation exists
-                    # to shrink
-                    "ragged": getattr(e, "ragged", "off"),
-                    "unit_occupancy": round(e.stats.unit_occupancy, 4),
-                    "bucket_batches": {
-                        str(b): c for b, c in sorted(
-                            e.stats.bucket_batches.items())},
-                    "compiled_programs": e.stats.compiled_programs,
-                    "compile_s": round(e.stats.compile_seconds, 3),
-                    "oversize_splits": e.stats.oversize_splits,
-                    # per-batch host clock means (ringbuf.STAGES order)
-                    "stage_ms": e.stats.stage_ms_per_batch(),
-                    # supervision lifecycle (engine/supervisor.py);
-                    # unsupervised raw engines report a static running
-                    "state": getattr(e, "state", "running"),
-                    "restarts": getattr(e, "restarts", 0),
-                    "last_stall_ts": getattr(e, "last_stall_ts", None),
-                    # submit-queue visibility (sched satellite): the
-                    # backlog that used to be invisible until the
-                    # stall watchdog tripped
-                    "queue_depth": e.queue_depth(),
-                    "queue_age_s": round(e.queue_age_s(), 3),
-                    # per-class depths when the QoS layer is on
-                    "sched_queues": e.class_depths(),
-                }
-                for k, e in self._engines.items()
-            }
+            engines = dict(self._engines)
+        default_dev = (str(self.plan.mesh.devices.flat[0])
+                       if self.plan is not None else None)
+        out: dict[str, dict] = {}
+        for k, e in engines.items():
+            if hasattr(e, "shard_rows"):  # FleetEngine (duck-typed: no cycle)
+                for label, dev, sub in e.shard_rows():
+                    out[f"{k}@{label}"] = self._stat_row(
+                        sub, shard=label, device=dev, group=k)
+            else:
+                out[k] = self._stat_row(
+                    e, shard=None, device=default_dev, group=k)
+        return out
 
     def stage_summary(self) -> dict[str, float]:
         """Batch-weighted mean per-batch host-stage cost across ALL
@@ -443,6 +509,36 @@ class EngineHub:
             "degraded": sum(1 for s in states if s == "degraded"),
             "restarts": sum(getattr(e, "restarts", 0) for e in engines),
         }
+
+    def fleet_summary(self) -> dict:
+        """The /scheduler fleet operating point (fixed keys — route
+        golden): placement counts per chip, live/degraded shard
+        counts, and the cumulative rebalance total. EVAM_FLEET=off
+        reports the same shape with zeros so dashboards and the bench
+        serve line don't branch on mode."""
+        with self._lock:
+            engines = list(self._engines.values())
+        out = {
+            "mode": "sharded" if self.fleet_active else "off",
+            "shards": 0,
+            "degraded_shards": 0,
+            "rebalances": 0,
+            "streams": {},
+        }
+        for e in engines:
+            if not hasattr(e, "shard_rows"):  # FleetEngine only
+                continue
+            s = e.fleet_summary()
+            # every engine kind shards over the same chips: shard
+            # counts report the widest view, placement counts sum
+            # (a stream pins once per engine kind it traverses)
+            out["shards"] = max(out["shards"], s["shards"])
+            out["degraded_shards"] = max(
+                out["degraded_shards"], s["degraded_shards"])
+            out["rebalances"] += s["rebalances"]
+            for label, n in s["streams"].items():
+                out["streams"][label] = out["streams"].get(label, 0) + n
+        return out
 
     def stop(self) -> None:
         with self._lock:

@@ -90,17 +90,31 @@ class RaggedSpec:
         return max(self.max_units, bucket * self.unit_budget)
 
 
-def consolidate_buckets(buckets: list[int]) -> list[int]:
+def consolidate_buckets(buckets: list[int], align: int = 1) -> list[int]:
     """Thin a power-of-two bucket ladder so adjacent shapes share a
     program: keep the floor, the top, and every OTHER rung between
     (descending from the top so the serving bucket keeps its exact
     shape). Halves compiled-program count; batches that would have
     used a dropped rung round up one rung — their pad rows are masked
-    or discarded exactly as before."""
+    or discarded exactly as before.
+
+    ``align`` is the mesh data-axis size: every kept rung >= align is
+    rounded up to a multiple of it AT LADDER BUILD (MeshPlan.pad_batch
+    applied here, once), so a sealed block dispatched sharded is never
+    re-padded per batch — a rung that isn't divisible by the data axis
+    would force an extra host-side copy on EVERY dispatch through that
+    bucket. Rungs below align (the fleet mode's single-device small
+    buckets) are left alone: they dispatch locally, unsharded."""
     if len(buckets) <= 2:
-        return list(buckets)
-    keep = {buckets[0], buckets[-1]}
-    # every other rung, walking DOWN from the top
-    for i in range(len(buckets) - 1, -1, -2):
-        keep.add(buckets[i])
-    return sorted(keep)
+        kept = list(buckets)
+    else:
+        keep = {buckets[0], buckets[-1]}
+        # every other rung, walking DOWN from the top
+        for i in range(len(buckets) - 1, -1, -2):
+            keep.add(buckets[i])
+        kept = sorted(keep)
+    if align > 1:
+        kept = sorted({
+            -(-b // align) * align if b >= align else b for b in kept
+        })
+    return kept

@@ -108,7 +108,8 @@ class SupervisedEngine:
     # ------------------------------------------------------------- API
 
     def submit(self, priority: str = "standard",
-               units: int | None = None, **inputs) -> Future:
+               units: int | None = None,
+               stream: str | None = None, **inputs) -> Future:
         with self._lock:
             state = self.state
             eng = self._engine
@@ -127,7 +128,8 @@ class SupervisedEngine:
                 f"engine {self.name} is restarting after a wedge; "
                 "retry shortly"
             )
-        return eng.submit(priority=priority, units=units, **inputs)
+        return eng.submit(priority=priority, units=units, stream=stream,
+                          **inputs)
 
     def warm_async(self, **example) -> None:
         with self._lock:
@@ -188,7 +190,13 @@ class SupervisedEngine:
 
     def _absorb_counters(self, eng: BatchEngine) -> None:
         """Fold a quarantined engine's cumulative counters into the
-        carry BEFORE it is abandoned and replaced."""
+        carry BEFORE it is abandoned and replaced.
+
+        The fleet layer (evam_tpu/fleet/engine.py) applies this same
+        carry discipline one level up when a PLACEMENT MOVE retires a
+        degraded shard: the shard's merged counters (which already
+        include this carry) are absorbed into the fleet-level carry,
+        so /healthz and the bench line stay monotonic fleet-wide."""
         try:
             shed = eng.shed_counts()
             live = eng.stats

@@ -1,0 +1,16 @@
+"""Fleet-scale serving (EVAM_FLEET): per-chip engine shards behind a
+consistent-hash stream placer, with a mesh-sharded engine for the
+data-parallel big buckets.
+
+Every prior perf layer (ringbuf, transfer overlap, gating, ragged
+packing) made a single chip faster; this package is the scale-OUT
+axis. The reference EVAM scales by running N independent pipeline
+processes (SURVEY §2d-1) — here the N single-device engines live
+inside one process, one per mesh device, fronted by placement and a
+fleet-wide admission view instead of an external load balancer.
+"""
+
+from evam_tpu.fleet.engine import FleetEngine, fleet_mode
+from evam_tpu.fleet.placer import ConsistentHashPlacer
+
+__all__ = ["ConsistentHashPlacer", "FleetEngine", "fleet_mode"]

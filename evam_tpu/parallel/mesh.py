@@ -51,6 +51,18 @@ class MeshPlan:
         d = self.data_size
         return -(-n // d) * d
 
+    def per_device_plans(self) -> list["MeshPlan"]:
+        """One single-device data-mesh plan per device of this mesh,
+        in mesh order — the fleet mode's shard plans (EVAM_FLEET):
+        each shard engine jits over its own chip, so small buckets
+        never pay a collective, and ``pad_batch`` is the identity
+        (data size 1)."""
+        return [
+            MeshPlan(mesh=Mesh(np.asarray([dev]), (self.data_axis,)),
+                     data_axis=self.data_axis)
+            for dev in self.mesh.devices.flat
+        ]
+
 
 def build_mesh(
     shape: list[int] | None = None,

@@ -167,12 +167,22 @@ class AdmissionController:
         return max(0.0, self.demand_fps() - gate_registry.skipped_fps())
 
     def capacity_fps(self) -> float:
-        """Declared capacity, or the bottleneck-engine projection from
-        live stats; 0 = unknown (cold hub — admit)."""
+        """Declared capacity, or the bottleneck projection from live
+        stats; 0 = unknown (cold hub — admit).
+
+        Fleet-aware aggregation (evam_tpu/fleet/): each stats row
+        derives ITS OWN capacity from its own EngineStats (per-chip
+        service time × per-chip batch fill), rows are summed within
+        their ``group`` (the shards of one engine key are parallel
+        capacity, Σ shards — not independent bottlenecks), and the
+        fleet capacity is the min ACROSS groups (a pipeline is still
+        bounded by its slowest engine kind). Single-chip rows are
+        their own group, so EVAM_FLEET=off reproduces the old
+        bottleneck-engine number exactly."""
         if self.cfg.capacity_fps > 0:
             return self.cfg.capacity_fps
-        caps = []
-        for stats in self.hub.stats().values():
+        group_caps: dict[str, float] = {}
+        for key, stats in self.hub.stats().items():
             batches = stats.get("batches")
             if not batches:
                 continue
@@ -193,8 +203,10 @@ class AdmissionController:
             else:
                 occ = max(float(stats.get("mean_occupancy", 0.0)), 1e-3)
                 per_batch = occ * self.hub.max_batch
-            caps.append((1e3 / service_ms) * per_batch)
-        return min(caps) if caps else 0.0
+            group = stats.get("group") or key
+            group_caps[group] = (group_caps.get(group, 0.0)
+                                 + (1e3 / service_ms) * per_batch)
+        return min(group_caps.values()) if group_caps else 0.0
 
     def utilization(self) -> float:
         cap = self.capacity_fps()

@@ -48,6 +48,14 @@ class PipelineRegistry:
                 shape=list(settings.tpu.mesh_shape),
                 axes=list(settings.tpu.mesh_axes),
             )
+            if (settings.tpu.fleet == "sharded"
+                    and settings.tpu.fleet_shards > 0):
+                # canary/bench knob: shard over the first N chips only
+                # (scaling curves, partial-fleet rollout)
+                import jax
+
+                devices = list(jax.devices())[:settings.tpu.fleet_shards]
+                plan = build_mesh(devices=devices)
             registry = ModelRegistry(
                 models_dir=settings.models_dir,
                 dtype=settings.tpu.precision,
@@ -71,6 +79,8 @@ class PipelineRegistry:
                 transfer=settings.tpu.transfer,
                 ragged=settings.tpu.ragged,
                 ragged_unit_budget=settings.tpu.ragged_unit_budget,
+                fleet=settings.tpu.fleet,
+                fleet_shard_max_batch=settings.tpu.fleet_shard_max_batch,
             )
         self.hub = hub
         #: QoS layer (evam_tpu/sched/): the hub's sched config is the
@@ -379,6 +389,13 @@ class PipelineRegistry:
         out["shed"] = self.hub.shed_totals()
         out["queues"] = self.hub.class_queue_depths()
         out["queue"] = self.hub.queue_summary()
+        # fleet operating point (evam_tpu/fleet/): per-chip placement
+        # counts, shard health, rebalance total — zeros, same shape,
+        # when EVAM_FLEET=off or the hub is embedder-supplied
+        fleet_fn = getattr(self.hub, "fleet_summary", None)
+        out["fleet"] = (fleet_fn() if fleet_fn is not None else {
+            "mode": "off", "shards": 0, "degraded_shards": 0,
+            "rebalances": 0, "streams": {}})
         return out
 
     def stop_all(self) -> int:

@@ -176,6 +176,7 @@ class DetectStage(AsyncStage):
             return None  # inference-interval skip: reuse last regions
         return self.engine.submit(
             priority=ctx.priority,
+            stream=ctx.stream_id,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
@@ -278,6 +279,7 @@ class ClassifyStage(AsyncStage):
         return self.engine.submit(
             priority=ctx.priority,
             units=len(regions),
+            stream=ctx.stream_id,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire),
             boxes=boxes)
 
@@ -351,8 +353,10 @@ class ActionStage(AsyncStage):
         pipeline runs at encoder throughput.
         """
         prio = ctx.priority
+        stream_id = ctx.stream_id
         enc_fut = self.enc_engine.submit(
             priority=prio,
+            stream=ctx.stream_id,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
         outer: Future = Future()
 
@@ -371,7 +375,9 @@ class ActionStage(AsyncStage):
                     return
                 clip = np.stack(self.clip)  # [T, D]
                 # raises RuntimeError when the engine is stopping
-                dec_fut = self.dec_engine.submit(priority=prio, clips=clip)
+                dec_fut = self.dec_engine.submit(priority=prio,
+                                                 stream=stream_id,
+                                                 clips=clip)
             except Exception as exc:  # noqa: BLE001 — propagate to the runner
                 outer.set_exception(exc)
                 return
@@ -439,6 +445,7 @@ class AudioDetectStage(AsyncStage):
             return None
         self._since_last = 0
         return self.engine.submit(priority=ctx.priority,
+                                  stream=ctx.stream_id,
                                   windows=self._buffer.copy())
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
@@ -531,6 +538,7 @@ class FusedDetectClassifyStage(AsyncStage):
             return None
         return self.engine.submit(
             priority=ctx.priority,
+            stream=ctx.stream_id,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:

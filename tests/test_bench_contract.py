@@ -94,6 +94,13 @@ def test_bench_serialize_compile_serve_emits_contract_line():
     assert {"streams", "ran", "skipped", "skip_rate",
             "skipped_fps"} == set(data["gate"])
     assert data["gate"]["skipped"] == 0
+    # fleet operating point rides the line with fixed keys whether
+    # EVAM_FLEET is off (this run: mode=off, zero shards) or sharded
+    # (evam_tpu/fleet/, hub.fleet_summary())
+    assert {"mode", "shards", "degraded_shards", "rebalances",
+            "streams"} == set(data["fleet"])
+    assert data["fleet"]["mode"] == "off"
+    assert data["fleet"]["shards"] == 0
 
 
 def test_bench_hostpath_slot_not_slower_than_legacy():
@@ -111,6 +118,26 @@ def test_bench_hostpath_slot_not_slower_than_legacy():
     assert data["metric"] == "host_assembly_speedup"
     assert data["ok"] is True
     assert data["value"] >= 1.0
+
+
+def test_bench_fleet_smoke_scales_and_stays_bit_identical():
+    """The fleet-scaling gate (tools/bench_fleet.py --smoke): 1 vs 2
+    host-platform shards must scale >= 1.5x through the consistent-
+    hash placement + per-shard dispatch fabric, with per-stream
+    outputs bit-identical across fleet sizes."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_fleet.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    data = json.loads(r.stdout.strip().splitlines()[-1])
+    assert data["metric"] == "streams_1080p_30fps_per_fleet"
+    assert {"metric", "value", "unit", "vs_baseline", "ok",
+            "identical"} <= set(data)
+    assert data["ok"] is True
+    assert data["identical"] is True
+    assert data["vs_baseline"] >= 1.5
 
 
 def test_bench_unreachable_device_still_emits_contract_line():

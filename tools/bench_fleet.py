@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Fleet scaling microbench: streams served per fleet, 1 vs N shards.
+
+What this measures — and what it deliberately does not. The gate runs
+on a CPU host where every "chip" is an XLA host-platform virtual
+device sharing the same cores, so real model FLOPs cannot scale with
+shard count (8 shards of matmul on one core is still one core of
+matmul). What DOES scale — and what this bench isolates — is the
+serving fabric the fleet tentpole added: consistent-hash placement,
+per-shard dispatch/launch threads, per-shard staging and bucket
+assembly. Each shard's step function emulates its device's service
+time with a ``jax.pure_callback`` sleep (SERVICE_MS per batch, the
+ballpark of a 1080p detect batch on one chip): the shard's launcher
+thread blocks host-side exactly the way a real launcher blocks on a
+busy chip, and blocked threads overlap perfectly across shards even
+on one core. A fleet whose fabric serializes anywhere (global lock,
+single dispatcher, placement hotspot) fails the ratio gate; a fleet
+whose shards are truly independent scales ~linearly. Real-compute
+numbers on real ICI belong to the next TPU window (ROADMAP battery:
+``streams_1080p_30fps_per_fleet``); ``--real-compute`` runs the same
+harness with an arithmetic step for that banking run.
+
+Per-stream outputs must be bit-identical between the 1-shard and
+N-shard fleets — placement decides WHERE a frame runs, never what it
+computes.
+
+Contract (tests/test_bench_contract.py): exactly ONE JSON line on
+stdout -- {"metric": "streams_1080p_30fps_per_fleet", "value", "unit",
+"vs_baseline", "ok", ...}; diagnostics on stderr; exit 1 when the
+scaling ratio or bit-identity gate fails. ``--smoke`` compares 1 vs 2
+shards with a 1.5x floor (core-count independent) for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EVAM_LOG_LEVEL", "warning")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from evam_tpu.engine.batcher import BatchEngine  # noqa: E402
+from evam_tpu.fleet import FleetEngine  # noqa: E402
+from evam_tpu.parallel.mesh import build_mesh  # noqa: E402
+
+#: emulated device service time: fixed dispatch cost per batch plus a
+#: per-row term (padded rows — the chip pays for the bucket shape it
+#: compiled, not the live items in it), ~50ms for a full batch of 8.
+#: Deliberately chunky: on the 1-core gate host the python serving
+#: fabric costs ~0.2ms/frame SERIALIZED across shards, so the
+#: emulated device time must dominate it the way a real detect batch
+#: dominates its (multi-core, parallel) host path — otherwise the
+#: bench measures the gate container's core count, not the fleet.
+SERVICE_BASE_MS = 2.0
+SERVICE_ROW_MS = 6.0
+FRAME_SHAPE = (16, 16, 3)
+MAX_BATCH = 8
+#: submit-side concurrency (ingest loops); placement noise is the
+#: real scaling limiter at small stream counts, so the defaults use
+#: fleet-scale stream counts (hundreds of cameras per 8 chips)
+FEEDERS = 32
+
+
+def _make_step(real_compute: bool):
+    """Returns (step_fn, service_switch). The switch starts False so
+    the warm pass compiles every bucket program without paying the
+    emulated service sleeps (sleep duration is runtime state, not part
+    of the traced program)."""
+    switch = {"on": False}
+    if real_compute:
+        def step(params, frames):
+            x = frames.astype(np.float32)
+            for _ in range(8):
+                x = x * 1.0009765625 + 0.5
+            return x
+        return step, switch
+
+    def _service(x):
+        if switch["on"]:
+            time.sleep(
+                (SERVICE_BASE_MS + SERVICE_ROW_MS * x.shape[0]) / 1e3)
+        return x
+
+    def step(params, frames):
+        x = frames.astype(np.float32) * 1.0009765625 + 0.5
+        return jax.pure_callback(
+            _service, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    return step, switch
+
+
+def _frames(streams: int, per_stream: int):
+    """Deterministic per-(stream, seq) payloads for the identity gate."""
+    out = []
+    for s in range(streams):
+        rng = np.random.default_rng(1000 + s)
+        out.append([
+            rng.integers(0, 255, FRAME_SHAPE, np.uint8)
+            for _ in range(per_stream)])
+    return out
+
+
+def _run_fleet(n_shards: int, frames, real_compute: bool):
+    """Serve every frame through an n-shard fleet; returns
+    (fps, outputs[stream][seq])."""
+    plans = build_mesh(
+        devices=list(jax.devices())[:n_shards]).per_device_plans()
+    step, service = _make_step(real_compute)
+
+    def shard_factory(plan, label):
+        return BatchEngine(
+            label, step, params=None, plan=plan, max_batch=MAX_BATCH,
+            deadline_ms=1.0, stall_timeout_s=0)
+
+    fleet = FleetEngine(f"bench@{n_shards}", shard_factory, plans)
+    streams = len(frames)
+    try:
+        per_stream = len(frames[0])
+
+        def burst():
+            # bounded feeder pool, streams interleaved: arrivals keep
+            # hitting every shard throughout the burst, and a feeder
+            # blocked on one hot shard's staging ring cannot starve
+            # the rest of the fleet (the single-submitter trap)
+            import threading
+
+            outs = [[None] * per_stream for _ in range(streams)]
+
+            def feed(fid):
+                own = range(fid, streams, FEEDERS)
+                futs = []
+                for i in range(per_stream):
+                    for s in own:
+                        futs.append((s, i, fleet.submit(
+                            stream=f"cam{s}", frames=frames[s][i])))
+                for s, i, fut in futs:
+                    outs[s][i] = np.asarray(fut.result(timeout=120))
+
+            threads = [threading.Thread(target=feed, args=(fid,))
+                       for fid in range(min(FEEDERS, streams))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return outs
+
+        burst()  # warm service-free: compile every bucket, no sleeps
+        service["on"] = True
+        t0 = time.perf_counter()
+        outs = burst()
+        elapsed = time.perf_counter() - t0
+        total = sum(len(f) for f in frames)
+        return total / elapsed, outs
+    finally:
+        fleet.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: 1 vs 2 shards, ratio >= 1.5x")
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--streams", type=int, default=768)
+    ap.add_argument("--frames", type=int, default=2)
+    ap.add_argument("--min-ratio", type=float, default=None)
+    ap.add_argument("--real-compute", action="store_true",
+                    help="arithmetic step instead of emulated service "
+                         "time (TPU banking runs)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        shards, streams, per_stream = 2, 96, 4
+        min_ratio = args.min_ratio if args.min_ratio is not None else 1.5
+    else:
+        shards, streams, per_stream = args.shards, args.streams, args.frames
+        min_ratio = args.min_ratio if args.min_ratio is not None else 6.0
+
+    frames = _frames(streams, per_stream)
+    fps_1, outs_1 = _run_fleet(1, frames, args.real_compute)
+    fps_n, outs_n = _run_fleet(shards, frames, args.real_compute)
+
+    identical = all(
+        np.array_equal(a, b)
+        for sa, sb in zip(outs_1, outs_n) for a, b in zip(sa, sb))
+    ratio = fps_n / fps_1 if fps_1 > 0 else 0.0
+    ok = bool(ratio >= min_ratio and identical)
+
+    print(
+        f"fleet bench: {streams} streams x {per_stream} frames, "
+        f"service {SERVICE_BASE_MS}+{SERVICE_ROW_MS}/row ms: "
+        f"1 shard {fps_1:.0f} fps, "
+        f"{shards} shards {fps_n:.0f} fps ({ratio:.2f}x, floor "
+        f"{min_ratio}x), bit-identical={identical}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "streams_1080p_30fps_per_fleet",
+        "value": round(fps_n / 30.0, 1),
+        "unit": "streams",
+        "vs_baseline": round(ratio, 2),
+        "ok": ok,
+        "shards": shards,
+        "baseline_streams": round(fps_1 / 30.0, 1),
+        "identical": identical,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Chip-loss soak: lose one shard mid-traffic, fail zero streams.
+
+The fleet acceptance scenario (ISSUE 11): a multi-chip fleet is
+serving realtime + standard streams when one chip wedges hard
+(``EVAM_FAULT_INJECT wedge``, the PR-4 fault hook, armed mid-run with
+a zero restart budget so the supervisor takes the shard to terminal
+``degraded`` — a lost chip, not a recoverable stall). The contract
+under that loss:
+
+* the shard's streams MIGRATE (consistent-hash drain-and-rebalance,
+  counted on ``evam_fleet_rebalance_total`` via
+  ``fleet_summary()["rebalances"]``);
+* in-flight work on the dead shard resolves or sheds PER CLASS
+  POLICY (``evam_sched_shed_total`` / ``hub.shed_totals()``) — it
+  does not hang;
+* every realtime stream keeps completing frames after the loss:
+  chip loss degrades fleet capacity, never a stream's liveness.
+
+Exit 0 iff a shard actually degraded AND zero realtime streams
+stopped completing. Prints ONE JSON line on stdout; diagnostics on
+stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")
+os.environ.setdefault("EVAM_LOG_LEVEL", "warning")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+MODEL = "object_detection/person_vehicle_bike"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _build_hub(shards: int):
+    import jax
+
+    from evam_tpu.engine.hub import EngineHub
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+    from evam_tpu.parallel.mesh import build_mesh
+
+    overrides = {k: (64, 64) for k in ZOO_SPECS}
+    overrides["audio_detection/environment"] = (1, 1600)
+    registry = ModelRegistry(
+        dtype="float32", input_overrides=overrides,
+        width_overrides={k: 8 for k in ZOO_SPECS})
+    plan = build_mesh(devices=list(jax.devices())[:shards])
+    return EngineHub(
+        registry, plan=plan, max_batch=16, deadline_ms=2.0,
+        supervise=True, max_restarts=0, stall_timeout_s=1.0,
+        first_batch_grace=15.0, fleet="sharded")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--realtime", type=int, default=8)
+    ap.add_argument("--standard", type=int, default=4)
+    ap.add_argument("--pre-s", type=float, default=3.0,
+                    help="healthy traffic before the chip loss")
+    ap.add_argument("--post-s", type=float, default=4.0,
+                    help="observation window after the loss")
+    ap.add_argument("--wedge-s", type=float, default=60.0)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from evam_tpu.obs import faults
+    from evam_tpu.ops.color import wire_shape
+
+    hub = _build_hub(args.shards)
+    eng = hub.engine("detect", MODEL)
+    frame = np.zeros(tuple(wire_shape("i420", 64, 64)), np.uint8)
+
+    streams = ([(f"rt{i}", "realtime") for i in range(args.realtime)]
+               + [(f"std{i}", "standard") for i in range(args.standard)])
+
+    # warm every shard's hot bucket before arming the fault: the wedge
+    # must hit a mid-traffic batch, not a first-compile one
+    for sid, prio in streams:
+        eng.submit(priority=prio, stream=sid, frames=frame).result(
+            timeout=120)
+    log(f"warmed {len(streams)} streams over {args.shards} shards")
+
+    stop = threading.Event()
+    post_loss = threading.Event()
+    done_pre = {sid: 0 for sid, _ in streams}
+    done_post = {sid: 0 for sid, _ in streams}
+    errors = {sid: 0 for sid, _ in streams}
+
+    def pump(sid, prio):
+        while not stop.is_set():
+            try:
+                fut = eng.submit(priority=prio, stream=sid,
+                                 frames=frame)
+                fut.result(timeout=10)
+            except Exception:
+                # shed / restarting / degraded-shard window: the
+                # stream retries — liveness is the assertion, not
+                # per-frame success during the loss transient
+                errors[sid] += 1
+                time.sleep(0.05)
+                continue
+            (done_post if post_loss.is_set() else done_pre)[sid] += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=pump, args=s, daemon=True)
+               for s in streams]
+    for t in threads:
+        t.start()
+    time.sleep(args.pre_s)
+
+    # chip loss: wedge exactly one batch for longer than the stall
+    # timeout, with a zero restart budget -> terminal degraded shard
+    os.environ["EVAM_FAULT_INJECT"] = (
+        f"wedge=1,wedge_s={args.wedge_s},wedge_n=1")
+    faults.reset_cache()
+    log("fault armed: wedge=1 (one batch, terminal)")
+
+    deadline = time.monotonic() + 45.0
+    degraded = 0
+    while time.monotonic() < deadline:
+        degraded = hub.fleet_summary()["degraded_shards"]
+        if degraded >= 1:
+            break
+        time.sleep(0.2)
+    log(f"degraded shards: {degraded}")
+    post_loss.set()
+    time.sleep(args.post_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+
+    summary = hub.fleet_summary()
+    sheds = hub.shed_totals()
+    failed_rt = [sid for sid, prio in streams
+                 if prio == "realtime" and done_post[sid] == 0]
+    ok = bool(degraded >= 1 and not failed_rt)
+
+    log(f"pre-loss completions: {sum(done_pre.values())}, post-loss: "
+        f"{sum(done_post.values())}, transient errors: "
+        f"{sum(errors.values())}")
+    log(f"fleet: {summary}, sheds: {sheds}, failed realtime streams: "
+        f"{failed_rt}")
+
+    print(json.dumps({
+        "metric": "fleet_soak_failed_realtime_streams",
+        "value": len(failed_rt),
+        "unit": "streams",
+        "vs_baseline": 0.0,
+        "ok": ok,
+        "degraded_shards": summary["degraded_shards"],
+        "rebalances": summary["rebalances"],
+        "sheds": sheds,
+        "post_loss_completions": sum(done_post.values()),
+        "transient_errors": sum(errors.values()),
+    }))
+    hub.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
