@@ -1,0 +1,41 @@
+"""evamlint: project-invariant static analysis for the threaded
+serving stack.
+
+Seven PRs of growth produced a deeply multithreaded engine whose
+correctness rests on hand-maintained invariants, and the history shows
+them breaking by hand: the unlocked ``+=`` drop-counter race (PR 1),
+stale queue gauges on wedged engines (PR 4), per-batch ``os.environ``
+reads in the fault injector (PR 4), the hub⇄fleet import knot (PR 7),
+and every PR's manual re-plumbing of ``EVAM_*`` knobs across
+settings/compose/helm/docs.  Each pass here machine-checks one of
+those bug classes:
+
+- ``locks``     — mutations of declared thread-shared attributes must
+                  happen under the declared lock (``SHARED_UNDER`` map
+                  or ``@locked_by`` decorator; see ``annotations.py``).
+- ``hotloop``   — no env reads, file I/O, ``time.sleep`` or metric
+                  registration inside dispatcher/launcher/completer/
+                  watchdog loop bodies.
+- ``knobs``     — every ``EVAM_*`` key read by ``config/settings.py``
+                  (plus ``obs.faults.ENV_KEYS``) is plumbed through
+                  compose, helm values, the helm env block and README;
+                  no ``EVAM_*`` env read outside settings + faults.
+- ``contracts`` — metric names/label sets match ``obs.metrics.
+                  METRIC_SPECS``; the stage-name list is consistent
+                  across ringbuf/admission/bench/tests; bench serve-
+                  line keys match the test pins.
+- ``imports``   — no package-level import cycles.
+
+Run ``python -m evam_tpu.analysis`` (or ``tools/evamlint.py``).
+Suppressions live in ``analysis/allowlist.toml`` — one entry per
+finding, each with a written justification.  The lock-discipline
+section of the allowlist is required to stay empty.
+"""
+
+from .core import Finding, Allowlist, repo_root, run_passes, PASS_IDS
+from .annotations import locked_by
+
+__all__ = [
+    "Finding", "Allowlist", "repo_root", "run_passes", "PASS_IDS",
+    "locked_by",
+]

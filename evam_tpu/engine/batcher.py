@@ -199,6 +199,17 @@ class BatchEngine:
     matches. Bucketed batch sizes keep the number of distinct
     compiled programs small (recompilation-storm guard)."""
 
+    #: Cross-thread mutable state and the lock that guards it — the
+    #: dispatcher, launcher, completer, watchdog, and warmup threads
+    #: all touch these.  ``evam_tpu.analysis`` (lock-discipline pass)
+    #: enforces that every mutation happens under ``_exec_lock``.
+    SHARED_UNDER = {
+        "stats": "_exec_lock",
+        "_buckets_done": "_exec_lock",
+        "_outstanding": "_exec_lock",
+        "_next_batch_id": "_exec_lock",
+    }
+
     def __init__(
         self,
         name: str,
@@ -601,15 +612,18 @@ class BatchEngine:
             t0 = time.perf_counter()
             with devlock.device_call(f"{self.name}:warmup"):
                 np.asarray(self._run(batch))
-            if b not in self._buckets_done:
-                # compile-cache accounting: a bucket's first run pays
-                # jit trace + XLA compile — bank it so consolidation's
-                # "fewer programs" claim is measurable
-                self.stats.compiled_programs += 1
-                self.stats.compile_seconds += time.perf_counter() - t0
-            # warmed bucket = compiled: its batches get the plain
-            # (not first-batch-grace) watchdog budget from here on
-            self._buckets_done.add(b)
+            with self._exec_lock:
+                if b not in self._buckets_done:
+                    # compile-cache accounting: a bucket's first run
+                    # pays jit trace + XLA compile — bank it so
+                    # consolidation's "fewer programs" claim is
+                    # measurable
+                    self.stats.compiled_programs += 1
+                    self.stats.compile_seconds += (
+                        time.perf_counter() - t0)
+                # warmed bucket = compiled: its batches get the plain
+                # (not first-batch-grace) watchdog budget from here on
+                self._buckets_done.add(b)
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
 
     def _warm_batch(self, example: dict[str, np.ndarray],
@@ -792,7 +806,8 @@ class BatchEngine:
         return self.buckets[-1]
 
     def _count_oversize_split(self, extra: int) -> None:
-        self.stats.oversize_splits += extra
+        with self._exec_lock:
+            self.stats.oversize_splits += extra
         metrics.inc("evam_engine_oversize_splits", float(extra),
                     labels={"engine": self.name})
 
@@ -869,39 +884,44 @@ class BatchEngine:
     def _record_batch(self, n: int, b: int, clock: dict[str, float],
                       items: list[_WorkItem] | None = None,
                       sealed: SealedBatch | None = None) -> None:
-        self.stats.batches += 1
-        self.stats.items += n
-        self.stats.occupancy_sum += n / b
-        # honest unit accounting (engine/ragged.py): what the program
-        # COMPUTED (unit_slots) vs the real work inside it (units).
-        # Packed batches know both exactly from the sealed descriptor;
-        # dense batches compute bucket × max_units unit rows and fall
-        # back to the pessimistic budget for items that didn't declare
-        # their real count. Frame-per-row engines: 1 unit per item.
         spec = self.ragged_spec
-        if sealed is not None and sealed.row_len is not None:
-            self.stats.units += sealed.units
-            self.stats.unit_slots += sealed.unit_rows
-        elif spec is not None:
-            self.stats.unit_slots += b * spec.max_units
-            self.stats.units += sum(
-                (it.units if it.units is not None else spec.max_units)
-                for it in (items or []))
-        else:
-            self.stats.unit_slots += b
-            self.stats.units += n
-        self.stats.bucket_batches[b] = (
-            self.stats.bucket_batches.get(b, 0) + 1)
+        with self._exec_lock:
+            self.stats.batches += 1
+            self.stats.items += n
+            self.stats.occupancy_sum += n / b
+            # honest unit accounting (engine/ragged.py): what the
+            # program COMPUTED (unit_slots) vs the real work inside it
+            # (units). Packed batches know both exactly from the
+            # sealed descriptor; dense batches compute bucket ×
+            # max_units unit rows and fall back to the pessimistic
+            # budget for items that didn't declare their real count.
+            # Frame-per-row engines: 1 unit per item.
+            if sealed is not None and sealed.row_len is not None:
+                self.stats.units += sealed.units
+                self.stats.unit_slots += sealed.unit_rows
+            elif spec is not None:
+                self.stats.unit_slots += b * spec.max_units
+                self.stats.units += sum(
+                    (it.units if it.units is not None else spec.max_units)
+                    for it in (items or []))
+            else:
+                self.stats.unit_slots += b
+                self.stats.units += n
+            self.stats.bucket_batches[b] = (
+                self.stats.bucket_batches.get(b, 0) + 1)
+            for stage, dt in clock.items():
+                self.stats.add_stage(stage, dt)
+            mean_occ = self.stats.mean_occupancy
+            unit_occ = self.stats.unit_occupancy
         metrics.observe("evam_batch_occupancy", n / b, {"engine": self.name})
         # live occupancy for operators (satellite: occupancy export) —
         # both the item-fill mean and the pad-tax-honest unit view
-        metrics.set("evam_engine_occupancy", self.stats.mean_occupancy,
+        metrics.set("evam_engine_occupancy", mean_occ,
                     {"engine": self.name})
         metrics.set("evam_engine_unit_occupancy",
-                    self.stats.unit_occupancy, {"engine": self.name})
+                    unit_occ, {"engine": self.name})
         self.refresh_queue_gauges()
         for stage, dt in clock.items():
-            self.stats.add_stage(stage, dt)
             metrics.observe(
                 "evam_engine_stage_seconds", dt,
                 {"engine": self.name, "stage": stage})
@@ -1263,11 +1283,12 @@ class BatchEngine:
                 # mid-traffic cold bucket's round-trip IS its compile
                 # (compile-cache accounting; warmup banks warmed
                 # buckets before traffic instead)
-                if done[2] not in self._buckets_done:
-                    self.stats.compiled_programs += 1
-                    self.stats.compile_seconds += (
-                        time.perf_counter() - done[0])
-                self._buckets_done.add(done[2])
+                with self._exec_lock:
+                    if done[2] not in self._buckets_done:
+                        self.stats.compiled_programs += 1
+                        self.stats.compile_seconds += (
+                            time.perf_counter() - done[0])
+                    self._buckets_done.add(done[2])
             if sealed is not None:
                 # the staging block is free the moment the readback
                 # materialized the output on host
@@ -1303,8 +1324,9 @@ class BatchEngine:
                 else:
                     _safe_set_result(it.future, host[i])
             resolve_s = time.perf_counter() - t_res
-            self.stats.add_stage("readback", readback_s)
-            self.stats.add_stage("resolve", resolve_s)
+            with self._exec_lock:
+                self.stats.add_stage("readback", readback_s)
+                self.stats.add_stage("resolve", resolve_s)
             metrics.observe("evam_engine_stage_seconds", readback_s,
                             {"engine": self.name, "stage": "readback"})
             metrics.observe("evam_engine_stage_seconds", resolve_s,

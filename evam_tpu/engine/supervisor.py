@@ -46,6 +46,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable
 
+from evam_tpu.analysis.annotations import locked_by
 from evam_tpu.engine.batcher import BatchEngine, EngineStats
 from evam_tpu.obs import get_logger, metrics
 
@@ -64,6 +65,20 @@ class SupervisedEngine:
     so existing callers — including tests poking ``buckets`` or
     ``_bucket`` — keep working unchanged.
     """
+
+    #: Shared between the monitor thread and every caller thread
+    #: (submit/stop/healthz snapshots); guarded by ``_lock``
+    #: (enforced by the ``evam_tpu.analysis`` lock-discipline pass).
+    SHARED_UNDER = {
+        "state": "_lock",
+        "restarts": "_lock",
+        "last_stall_ts": "_lock",
+        "_shed_carry": "_lock",
+        "_stats_carry": "_lock",
+        "_example": "_lock",
+        "_warm_requested": "_lock",
+        "_engine": "_lock",
+    }
 
     def __init__(
         self,
@@ -222,6 +237,7 @@ class SupervisedEngine:
 
     # ------------------------------------------------------- internals
 
+    @locked_by("_lock")
     def _set_state(self, state: str) -> None:
         self.state = state
         metrics.set("evam_engine_state", float(ENGINE_STATES.index(state)),
@@ -258,7 +274,8 @@ class SupervisedEngine:
                 self._quarantine_and_rebuild(eng, reason)
 
     def _quarantine_and_rebuild(self, eng: BatchEngine, reason: str) -> None:
-        self.last_stall_ts = time.time()
+        with self._lock:
+            self.last_stall_ts = time.time()
         log.error("engine %s wedged (%s); quarantining", self.name, reason)
         self._absorb_counters(eng)
         eng.abandon()
@@ -278,10 +295,10 @@ class SupervisedEngine:
                 )
                 return
             self._restart_times.append(now)
-            self.restarts += 1
-            metrics.inc("evam_engine_restarts", labels={"engine": self.name})
             with self._lock:
+                self.restarts += 1
                 self._set_state("restarting")
+            metrics.inc("evam_engine_restarts", labels={"engine": self.name})
             attempt = len(self._restart_times)
             delay = min(self.backoff_s * (2 ** (attempt - 1)),
                         self.max_backoff_s)

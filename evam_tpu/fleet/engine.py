@@ -85,6 +85,24 @@ class FleetEngine:
     and ragged configuration.
     """
 
+    #: Placement/carry state is hit from every submitting stream
+    #: thread plus the degraded-sweep and drain threads; guarded by
+    #: ``_lock`` (RLock).  The lazily-built mesh engine has its own
+    #: creation lock.  Enforced by the ``evam_tpu.analysis`` lock-
+    #: discipline pass.
+    SHARED_UNDER = {
+        "shards": "_lock",
+        "_pins": "_lock",
+        "_degraded": "_lock",
+        "rebalances": "_lock",
+        "_stats_carry": "_lock",
+        "_shed_carry": "_lock",
+        "_restarts_carry": "_lock",
+        "_drains": "_lock",
+        "_example": "_lock",
+        "_mesh_eng": "_mesh_lock",
+    }
+
     def __init__(self, name: str, shard_factory, plans,
                  mesh_factory=None, vnodes: int = 512):
         if not plans:
@@ -196,7 +214,8 @@ class FleetEngine:
                              name=f"fleet-{self.name}-drain-{label}",
                              daemon=True)
         t.start()
-        self._drains.append(t)
+        with self._lock:
+            self._drains.append(t)
 
     @staticmethod
     def _safe_stop(eng) -> None:
@@ -298,13 +317,14 @@ class FleetEngine:
         return out
 
     def set_example(self, **example) -> None:
-        self._example = example
+        with self._lock:
+            self._example = example
         for e in self._members():
             e.set_example(**example)
 
     def warm_async(self, **example) -> None:
-        self._example = example
         with self._lock:
+            self._example = example
             shards = list(self.shards.values())
         for e in shards:
             e.warm_async(**example)

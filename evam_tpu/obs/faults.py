@@ -50,8 +50,18 @@ from evam_tpu.obs.metrics import metrics
 log = get_logger("obs.faults")
 
 
-_KNOWN_KEYS = {"drop", "stall", "stall_ms", "corrupt", "error",
-               "wedge", "wedge_s", "wedge_n"}
+#: The fault-injection environment surface, exported programmatically:
+#: ``evam_tpu.analysis`` (knob-plumbing pass) and the compose/helm doc
+#: surfaces derive the chaos keys from here instead of re-listing them.
+ENV_KEYS: tuple[str, ...] = ("EVAM_FAULT_INJECT", "EVAM_FAULT_SEED")
+
+#: Spec keys accepted inside EVAM_FAULT_INJECT, in doc order (see the
+#: module docstring) — the single source for "keys: drop, stall, …"
+#: lists in deploy configs.
+SPEC_KEYS: tuple[str, ...] = ("drop", "stall", "stall_ms", "corrupt",
+                              "error", "wedge", "wedge_s", "wedge_n")
+
+_KNOWN_KEYS = set(SPEC_KEYS)
 
 
 class FaultInjector:

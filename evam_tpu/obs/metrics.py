@@ -15,6 +15,60 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 
 
+#: The metric registration table: every ``evam_*`` series the process
+#: may emit, with its kind and the label keys call sites may attach.
+#: ``evam_tpu.analysis`` (the ``contracts`` pass) enforces that every
+#: metric call site in the package names a key registered here with a
+#: label-key subset of the spec — register new metrics HERE first.
+#: Subset (not equality) because several histograms are observed both
+#: in aggregate and per label (e.g. evam_frame_latency_seconds lands
+#: one unlabeled series plus a bounded per-class series).
+METRIC_SPECS: dict[str, tuple[str, tuple[str, ...]]] = {
+    # stream lifecycle / server
+    "evam_stream_failures": ("counter", ()),
+    "evam_shutdown_leaked_streams": ("gauge", ()),
+    "evam_frames_processed": ("counter", ("stream",)),
+    "evam_frame_errors": ("counter", ("stream",)),
+    # media ingest
+    "evam_frames_decoded": ("counter", ("stream",)),
+    # drops carry where in the pipeline the frame died ("decode" vs
+    # "downstream"); decode.py's plain per-stream drop counter omits it
+    "evam_frames_dropped": ("counter", ("stream", "stage")),
+    "evam_stream_errors": ("counter", ("stream",)),
+    # pipeline stage clock + end-to-end latency
+    "evam_stage_seconds": ("histogram", ("stage",)),
+    "evam_frame_latency_seconds": ("histogram", ("class",)),
+    # engine (batcher/supervisor) health
+    "evam_step_seconds": ("histogram", ("engine",)),
+    "evam_item_latency_seconds": ("histogram", ("engine",)),
+    "evam_engine_stage_seconds": ("histogram", ("engine", "stage")),
+    "evam_batch_occupancy": ("histogram", ("engine",)),
+    "evam_engine_occupancy": ("gauge", ("engine",)),
+    "evam_engine_unit_occupancy": ("gauge", ("engine",)),
+    "evam_engine_queue_depth": ("gauge", ("engine",)),
+    "evam_engine_queue_age_s": ("gauge", ("engine",)),
+    "evam_engine_stalls": ("counter", ("engine",)),
+    "evam_engine_state": ("gauge", ("engine",)),
+    "evam_engine_restarts": ("counter", ("engine",)),
+    "evam_engine_oversize_splits": ("counter", ("engine",)),
+    # QoS scheduling
+    "evam_sched_admitted": ("counter", ("class",)),
+    "evam_sched_rejected": ("counter", ("class",)),
+    "evam_sched_shed": ("counter", ("class",)),
+    # content-adaptive gating
+    "evam_gate_ran": ("counter", ("engine",)),
+    "evam_gate_skipped": ("counter", ("engine",)),
+    # fleet
+    "evam_fleet_rebalance_total": ("counter", ("engine",)),
+    # publishing + EII bridge
+    "evam_publish_dropped": ("counter", ("dest",)),
+    "evam_eii_published": ("counter", ()),
+    "evam_eii_ingest_drops": ("counter", ()),
+    # chaos / fault injection
+    "evam_faults_injected": ("counter", ("kind",)),
+}
+
+
 def _label_str(labels: dict[str, str] | None) -> str:
     if not labels:
         return ""

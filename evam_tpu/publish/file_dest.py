@@ -16,6 +16,7 @@ import sys
 import threading
 import time
 
+from evam_tpu.analysis.annotations import locked_by
 from evam_tpu.obs import get_logger
 from evam_tpu.obs.metrics import metrics
 
@@ -24,6 +25,10 @@ log = get_logger("publish.file")
 
 class FileDestination:
     """JSON-lines (default) or JSON-array metadata file."""
+
+    #: the publishing stream thread increments, /streams snapshots
+    #: read — guarded by ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {"_dropped": "_lock"}
 
     def __init__(self, path: str, fmt: str = "json-lines",
                  retry_backoff_s: float = 0.5, max_backoff_s: float = 10.0):
@@ -57,6 +62,7 @@ class FileDestination:
             self._opened_once = True
         return self._fh
 
+    @locked_by("_lock")
     def _drop(self, exc: OSError | None = None) -> None:
         self._dropped += 1
         metrics.inc("evam_publish_dropped", labels={"dest": "file"})

@@ -146,6 +146,10 @@ class MqttDestination:
     """Destination publishing metadata JSON (and optional frame blob on
     ``<topic>/frames``) with automatic reconnect."""
 
+    #: the publishing stream thread increments, /streams snapshots
+    #: read — guarded by ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {"_dropped": "_lock"}
+
     def __init__(
         self,
         host: str,
@@ -159,6 +163,7 @@ class MqttDestination:
         self._client = MqttClient(host, port)
         self._backoff = 0.5
         self._next_retry = 0.0
+        self._lock = threading.Lock()
         self._dropped = 0
         if not lazy:
             self._client.connect()
@@ -184,7 +189,8 @@ class MqttDestination:
     def _drop(self) -> None:
         # shared drop accounting across destination kinds (mqtt/zmq/
         # file): one metric an operator can alert on for ANY sink
-        self._dropped += 1
+        with self._lock:
+            self._dropped += 1
         metrics.inc("evam_publish_dropped", labels={"dest": "mqtt"})
 
     def publish(self, meta: dict, frame: bytes | None = None) -> None:

@@ -16,6 +16,7 @@ arrives while disconnected.
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 from evam_tpu.obs import get_logger
@@ -25,6 +26,10 @@ log = get_logger("publish.zmq")
 
 
 class ZmqDestination:
+    #: the publishing stream thread increments, /streams snapshots
+    #: read — guarded by ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {"_dropped": "_lock"}
+
     def __init__(
         self,
         endpoint: str = "tcp://127.0.0.1:65114",
@@ -38,6 +43,7 @@ class ZmqDestination:
         self.bind = bind
         self.send_hwm = send_hwm
         self.max_backoff_s = max_backoff_s
+        self._lock = threading.Lock()
         self._dropped = 0
         self._backoff = 0.5
         self._next_retry = 0.0
@@ -89,7 +95,8 @@ class ZmqDestination:
             return False
 
     def _drop(self) -> None:
-        self._dropped += 1
+        with self._lock:
+            self._dropped += 1
         metrics.inc("evam_publish_dropped", labels={"dest": "zmq"})
 
     def publish(self, meta: dict, frame: bytes | None = None) -> None:

@@ -101,6 +101,14 @@ class AdmissionController:
     stay populated.
     """
 
+    #: admit/release run on stream threads, snapshots on the server
+    #: thread — mutations must hold ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {
+        "_streams": "_lock",
+        "_admitted": "_lock",
+        "_rejected": "_lock",
+    }
+
     def __init__(self, hub, cfg: SchedConfig):
         self.hub = hub
         self.cfg = cfg

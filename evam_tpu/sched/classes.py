@@ -144,6 +144,15 @@ class ClassQueues:
     with an idle realtime lane it is exactly realtime-first.
     """
 
+    #: every queue mutation happens inside ``with self._cv:`` — the
+    #: condition variable doubles as the state lock (lock-discipline
+    #: pass enforces it).
+    SHARED_UNDER = {
+        "_q": "_cv",
+        "_starve": "_cv",
+        "_closed": "_cv",
+    }
+
     def __init__(self, starvation_limits: dict[str, int] | None = None):
         self._limits = dict(starvation_limits or STARVATION_LIMITS)
         self._cv = threading.Condition()

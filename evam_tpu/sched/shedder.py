@@ -57,6 +57,10 @@ class Shedder:
     monotonically from head to tail.
     """
 
+    #: dispatcher thread sheds, server/bench threads read the counts —
+    #: mutations must hold ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {"counts": "_lock"}
+
     def __init__(self, engine_name: str, staleness_s: dict[str, float]):
         self.engine_name = engine_name
         self.staleness_s = dict(staleness_s)

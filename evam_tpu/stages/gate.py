@@ -251,6 +251,14 @@ class GateRegistry:
     feed the admission controller's effective post-gate demand.
     """
 
+    #: stream threads record, server/bench threads snapshot —
+    #: mutations must hold ``_lock`` (lock-discipline pass).
+    SHARED_UNDER = {
+        "_gates": "_lock",
+        "_ran": "_lock",
+        "_skipped": "_lock",
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._gates: "weakref.WeakSet[MotionGate]" = weakref.WeakSet()

@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""evamlint wrapper — the pre-commit entry point.
+
+    tools/evamlint.py            # whole repo, like CI
+    tools/evamlint.py --diff     # only files changed vs main
+    tools/evamlint.py --json report.json
+
+Thin shim over ``python -m evam_tpu.analysis`` so it works without an
+installed package (adds the repo root to sys.path first).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from evam_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

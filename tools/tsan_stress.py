@@ -10,6 +10,9 @@ internal OpenMP team is exactly what TSAN must see. Exits non-zero on
 any data-race report.
 
 Run: ``python tools/tsan_stress.py`` (needs g++; ~20 s).
+``--smoke`` shrinks the stress (2 threads x 5 iters, 360p source) to a
+seconds-scale CI gate — same build, same kernels, same TSAN abort on
+any report; the full shape stays the pre-release soak.
 """
 
 from __future__ import annotations
@@ -40,7 +43,10 @@ def build() -> str:
     return lib
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    smoke = "--smoke" in (sys.argv[1:] if argv is None else argv)
+    n_threads, n_iters = (2, 5) if smoke else (8, 30)
+    src_h, src_w = (360, 640) if smoke else (1080, 1920)
     lib_path = build()
     if "libtsan" not in os.environ.get("LD_PRELOAD", ""):
         # dlopen-ing a TSAN-built .so into an unsanitized python hits
@@ -56,7 +62,8 @@ def main() -> int:
         env = dict(os.environ, LD_PRELOAD=candidates[0],
                    TSAN_OPTIONS="halt_on_error=1 exitcode=66")
         return subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env
+            [sys.executable, os.path.abspath(__file__)]
+            + (["--smoke"] if smoke else []), env=env
         ).returncode
     lib = ctypes.CDLL(lib_path)
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -68,7 +75,7 @@ def main() -> int:
     lib.bgr_to_i420.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int]
 
     rng = np.random.default_rng(0)
-    src = rng.integers(0, 255, (1080, 1920, 3), np.uint8)
+    src = rng.integers(0, 255, (src_h, src_w, 3), np.uint8)
     errors: list[Exception] = []
 
     def worker(tid: int) -> None:
@@ -76,21 +83,22 @@ def main() -> int:
             frame = np.ascontiguousarray(src)
             out_i420 = np.empty((512 * 3 // 2, 512), np.uint8)
             out_bgr = np.empty((512, 512, 3), np.uint8)
-            out_full = np.empty((1080 * 3 // 2, 1920), np.uint8)
-            for _ in range(30):
+            out_full = np.empty((src_h * 3 // 2, src_w), np.uint8)
+            for _ in range(n_iters):
                 lib.resize_bgr_to_i420(
-                    frame.ctypes.data_as(u8p), 1080, 1920,
+                    frame.ctypes.data_as(u8p), src_h, src_w,
                     out_i420.ctypes.data_as(u8p), 512, 512)
                 lib.resize_bgr(
-                    frame.ctypes.data_as(u8p), 1080, 1920,
+                    frame.ctypes.data_as(u8p), src_h, src_w,
                     out_bgr.ctypes.data_as(u8p), 512, 512)
                 lib.bgr_to_i420(
                     frame.ctypes.data_as(u8p),
-                    out_full.ctypes.data_as(u8p), 1080, 1920)
+                    out_full.ctypes.data_as(u8p), src_h, src_w)
         except Exception as exc:  # noqa: BLE001
             errors.append(exc)
 
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
     for t in threads:
         t.start()
     for t in threads:
@@ -98,8 +106,9 @@ def main() -> int:
     if errors:
         print("worker errors:", errors, file=sys.stderr)
         return 1
-    print("tsan stress: 8 threads x 30 iters x 3 kernels — "
-          "no races reported (TSAN aborts the process on a report)")
+    print(f"tsan stress: {n_threads} threads x {n_iters} iters x 3 "
+          "kernels — no races reported (TSAN aborts the process on a "
+          "report)")
     return 0
 
 
