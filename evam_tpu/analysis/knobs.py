@@ -5,6 +5,10 @@ compose, helm and docs by hand — and the surfaces drift.  This pass
 derives the knob inventory from the code:
 
 - every ``EVAM_*`` string constant in ``config/settings.py``, plus
+- every NON-``EVAM_`` env key registered in a ``from_env`` mapping
+  dict (``RUN_MODE``, ``PY_LOG_LEVEL``, ``PROFILING_MODE``, ... —
+  reference-parity keys that previously escaped this pass entirely
+  because the inventory only matched the ``EVAM_`` prefix), plus
 - ``obs.faults.ENV_KEYS`` (the fault-injection env surface, exported
   programmatically so compose/helm/docs derive from one source),
 
@@ -16,8 +20,9 @@ point is that an operator grepping the file finds the knob) in:
 - ``deploy/helm/templates/evam-deployment.yaml``
 - ``README.md``
 
-It also enforces the read-side rule: no ``EVAM_*`` environment read
-outside ``config/settings.py`` + ``obs/faults.py``.  Construction-time
+It also enforces the read-side rule: no environment read of an
+inventoried key (``EVAM_*`` or registered non-``EVAM_``) outside
+``config/settings.py`` + ``obs/faults.py``.  Construction-time
 fallbacks that tests monkeypatch are real reads — they take an
 allowlist entry with a justification, they don't get a free pass.
 """
@@ -41,10 +46,17 @@ SURFACES = (
 )
 
 _KEY_RE = re.compile(r"^EVAM_[A-Z0-9_]+$")
+#: shape of any plausible env-var name — used only for keys that sit
+#: in a from_env mapping dict, so "INFO"-style defaults don't match
+_ENV_KEY_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
 
 
 def settings_keys(files: list[SourceFile]) -> set[str]:
-    """All EVAM_* string constants in config/settings.py."""
+    """The env inventory of config/settings.py: every EVAM_* string
+    constant anywhere in the file, plus every mapping-dict key — a
+    dict whose values are ``(field, conv)`` tuples is a ``from_env``
+    env mapping, and its non-EVAM keys (RUN_MODE, PROFILING_MODE, ...)
+    are knobs too."""
     keys: set[str] = set()
     for sf in files:
         if sf.rel == SETTINGS and sf.tree is not None:
@@ -53,6 +65,13 @@ def settings_keys(files: list[SourceFile]) -> set[str]:
                         and isinstance(node.value, str) \
                         and _KEY_RE.match(node.value):
                     keys.add(node.value)
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str) \
+                                and isinstance(v, ast.Tuple) \
+                                and _ENV_KEY_RE.match(k.value):
+                            keys.add(k.value)
     return keys
 
 
@@ -78,9 +97,14 @@ def fault_keys(files: list[SourceFile]) -> tuple[set[str], Finding | None]:
 
 
 class _EnvReadScan(ast.NodeVisitor):
-    def __init__(self, sf: SourceFile, findings: list[Finding]):
+    def __init__(self, sf: SourceFile, findings: list[Finding],
+                 registered: set[str] = frozenset()):
         self.sf = sf
         self.findings = findings
+        #: the full knob inventory — reads of a REGISTERED non-EVAM
+        #: key (PY_LOG_LEVEL, DEV_MODE, ...) are in scope even though
+        #: the key lacks the EVAM_ prefix
+        self.registered = registered
 
     def _dotted(self, node: ast.expr) -> str:
         parts: list[str] = []
@@ -93,8 +117,9 @@ class _EnvReadScan(ast.NodeVisitor):
 
     def _flag(self, node: ast.AST, key_node: ast.expr | None) -> None:
         if isinstance(key_node, ast.Constant) and isinstance(key_node.value, str):
-            if not _KEY_RE.match(key_node.value):
-                return  # non-EVAM key: out of scope
+            if not _KEY_RE.match(key_node.value) \
+                    and key_node.value not in self.registered:
+                return  # unregistered non-EVAM key: out of scope
             ident, what = f"env-read:{key_node.value}", key_node.value
         else:
             ident, what = "env-read:dynamic", "a non-literal key"
@@ -149,5 +174,5 @@ def run(root: Path, files: list[SourceFile]) -> list[Finding]:
     for sf in files:
         if sf.tree is None or sf.rel in (SETTINGS, FAULTS):
             continue
-        _EnvReadScan(sf, findings).visit(sf.tree)
+        _EnvReadScan(sf, findings, registered=keys | fkeys).visit(sf.tree)
     return findings

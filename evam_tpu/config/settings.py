@@ -135,6 +135,30 @@ class SchedSettings(BaseModel):
     staleness_ms_batch: float = 5000.0
 
 
+class TraceSettings(BaseModel):
+    """Per-frame tracing knobs (obs/trace.py): trace ids minted at
+    ingest, span trees through engine dispatch, a bounded in-process
+    ring with tail-based sampling, and the quarantine flight
+    recorder. ``EVAM_TRACE=off`` disables the whole layer —
+    byte-identical A/B (tools/bench_trace.py), same discipline as
+    EVAM_TRANSFER / EVAM_GATE."""
+
+    enabled: bool = True
+    #: healthy-frame retention: keep 1-in-N (error/shed/deadline-miss
+    #: frames and the slow tail are ALWAYS retained regardless)
+    sample_n: int = 16
+    #: bounded ring capacity — retained frame traces and completed
+    #: batch records each (the ring never grows past this)
+    ring: int = 1024
+    #: frames slower than this end-to-end are "the slow tail" and are
+    #: always retained
+    slow_ms: float = 250.0
+    #: flight-recorder artifact directory; empty = <tmpdir>/evam_flight
+    flight_dir: str = ""
+    #: most-recent records of each kind written per flight dump
+    flight_n: int = 256
+
+
 class Settings(BaseModel):
     """Flat service settings resolved from env + optional config file."""
 
@@ -176,6 +200,7 @@ class Settings(BaseModel):
     drain_timeout_s: float = 5.0
     tpu: TPUSettings = Field(default_factory=TPUSettings)
     sched: SchedSettings = Field(default_factory=SchedSettings)
+    trace: TraceSettings = Field(default_factory=TraceSettings)
 
     @classmethod
     def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
@@ -253,6 +278,20 @@ class Settings(BaseModel):
             for var, (key, conv) in sched_mapping.items():
                 if var in env:
                     sched[key] = conv(env[var])
+
+        trace = data.setdefault("trace", {})
+        trace_mapping = {
+            "EVAM_TRACE": ("enabled", _parse_bool),
+            "EVAM_TRACE_SAMPLE_N": ("sample_n", int),
+            "EVAM_TRACE_RING": ("ring", int),
+            "EVAM_TRACE_SLOW_MS": ("slow_ms", float),
+            "EVAM_TRACE_FLIGHT_DIR": ("flight_dir", str),
+            "EVAM_TRACE_FLIGHT_N": ("flight_n", int),
+        }
+        if isinstance(trace, dict):
+            for var, (key, conv) in trace_mapping.items():
+                if var in env:
+                    trace[key] = conv(env[var])
         return cls.model_validate(data)
 
 

@@ -77,6 +77,7 @@ from evam_tpu.engine.ragged import (
 )
 from evam_tpu.engine.ringbuf import STAGES, SealedBatch, SlotRing
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs import trace
 from evam_tpu.obs.faults import current as active_faults
 from evam_tpu.parallel.mesh import MeshPlan
 from evam_tpu.sched.classes import (
@@ -100,6 +101,10 @@ class _WorkItem:
     #: classify engines) — honest-occupancy metadata. None = unknown;
     #: accounting then assumes the pessimistic dense budget.
     units: int | None = None
+    #: per-frame trace handle (obs/trace.py FrameTrace) — links this
+    #: item's frame span tree to the batch it rides in; None when
+    #: tracing is off or the caller has no frame context
+    trace: object | None = None
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -297,6 +302,11 @@ class BatchEngine:
         #: launcher split, upload queue, watchdog semantics) runs
         #: identically on CPU so tests exercise it end to end.
         self._device_streams = jax.default_backend() == "tpu"
+        #: device identity recorded on batch trace records — a fleet
+        #: shard's spans name the chip it serves (obs/trace.py)
+        self._trace_device = (str(plan.mesh.devices.flat[0])
+                              if plan is not None
+                              else jax.default_backend())
         #: QoS scheduling (evam_tpu/sched/): when set (and enabled),
         #: submit routes into per-class queues drained realtime-first
         #: with per-class batch deadlines and staleness shedding.
@@ -501,6 +511,7 @@ class BatchEngine:
     def submit(self, priority: str = DEFAULT_PRIORITY,
                units: int | None = None,
                stream: str | None = None,
+               trace: "object | None" = None,
                **inputs: np.ndarray) -> Future:
         """Enqueue one item (no batch dim); resolves to its packed row(s).
 
@@ -522,6 +533,12 @@ class BatchEngine:
         packed-ragged path it is derived from the ragged input's
         leading dim instead; the item then resolves to exactly its
         own rows of the packed output.
+
+        ``trace`` is the submitting frame's FrameTrace handle
+        (obs/trace.py) or None: the batch this item lands in records
+        the trace id (batch↔frame linkage) and the completion path
+        appends queue-wait + dispatch spans to the frame's tree.
+        Accepted and ignored — zero-cost — when tracing is off.
 
         On the slot path this call COPIES the item's arrays into the
         staging block on the calling thread (ringbuf.write) — the
@@ -552,13 +569,14 @@ class BatchEngine:
                     f"unknown priority {priority!r}; valid: "
                     f"{'|'.join(PRIORITIES)}")
             item = _WorkItem(inputs, fut, time.perf_counter(), priority,
-                             units)
+                             units, trace)
             try:
                 self._classq.put(priority, item)
             except RuntimeError:
                 raise RuntimeError(f"engine {self.name} is stopped") from None
             return fut
-        item = _WorkItem(inputs, fut, time.perf_counter(), units=units)
+        item = _WorkItem(inputs, fut, time.perf_counter(), units=units,
+                         trace=trace)
         if self._ring is not None:
             try:
                 self._ring.write(inputs, item)
@@ -944,6 +962,11 @@ class BatchEngine:
             self._in_flight.acquire()
             t0 = time.perf_counter()
             bid = self._track_dispatch(t0, items, b)
+            # the pending trace record holds the SAME clock dict _run
+            # fills in — a flight dump of a wedged batch reads the
+            # stages completed so far (obs/trace.py)
+            trace.batch_begin(self.name, bid, items, b, n, clock,
+                              self._trace_device)
             try:
                 out = self._run(batch, clock=clock)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
@@ -952,6 +975,8 @@ class BatchEngine:
                     self._outstanding.pop(bid, None)
                 for it in items:
                     _safe_set_exception(it.future, exc)
+                trace.batch_complete(self.name, bid, items,
+                                     status="error")
                 if sealed is not None:
                     self._ring.release(sealed)
                 log.exception("engine %s step failed", self.name)
@@ -1057,6 +1082,10 @@ class BatchEngine:
             self._in_flight.acquire()
             t0 = time.perf_counter()
             bid = self._track_dispatch(t0, items, b)
+            # clock by reference — same wedge-visibility contract as
+            # the inline path (obs/trace.py)
+            trace.batch_begin(self.name, bid, items, b, n, clock,
+                              self._trace_device)
             try:
                 out = self._launch(dev, clock, b)
             except Exception as exc:  # noqa: BLE001 — surface to every caller
@@ -1065,6 +1094,8 @@ class BatchEngine:
                     self._outstanding.pop(bid, None)
                 for it in items:
                     _safe_set_exception(it.future, exc)
+                trace.batch_complete(self.name, bid, items,
+                                     status="error")
                 if sealed is not None:
                     self._ring.release(sealed)
                 log.exception("engine %s step failed", self.name)
@@ -1269,6 +1300,8 @@ class BatchEngine:
             except Exception as exc:  # noqa: BLE001
                 for it in items:
                     _safe_set_exception(it.future, exc)
+                trace.batch_complete(self.name, bid, items,
+                                     status="error")
                 self._in_flight.release()
                 if sealed is not None:
                     self._ring.release(sealed)
@@ -1324,6 +1357,12 @@ class BatchEngine:
                 else:
                     _safe_set_result(it.future, host[i])
             resolve_s = time.perf_counter() - t_res
+            # retire the batch trace record (appends queue-wait +
+            # dispatch spans to every member frame's tree and banks
+            # the completion-side stages the clock never sees)
+            trace.batch_complete(self.name, bid, items,
+                                 readback_s=readback_s,
+                                 resolve_s=resolve_s)
             with self._exec_lock:
                 self.stats.add_stage("readback", readback_s)
                 self.stats.add_stage("resolve", resolve_s)

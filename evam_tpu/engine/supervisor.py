@@ -49,11 +49,29 @@ from typing import Callable
 from evam_tpu.analysis.annotations import locked_by
 from evam_tpu.engine.batcher import BatchEngine, EngineStats
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs import trace
 
 log = get_logger("engine.supervisor")
 
 #: gauge encoding for evam_engine_state, index = value
 ENGINE_STATES = ("running", "restarting", "degraded")
+
+
+def _engine_snapshot(eng) -> dict:
+    """Best-effort queue/in-flight snapshot of a wedged engine for the
+    flight-recorder header — taken before abandon() fails the stranded
+    futures and zeroes the evidence."""
+    try:
+        return {
+            "queue_depth": eng.queue_depth(),
+            "class_depths": eng.class_depths(),
+            "shed_counts": eng.shed_counts(),
+            "outstanding": len(eng._outstanding),
+            "stalled": eng.stalled.is_set(),
+            "batches": eng.stats.batches,
+        }
+    except Exception:  # noqa: BLE001 — engine mid-teardown
+        return {}
 
 
 class SupervisedEngine:
@@ -124,7 +142,8 @@ class SupervisedEngine:
 
     def submit(self, priority: str = "standard",
                units: int | None = None,
-               stream: str | None = None, **inputs) -> Future:
+               stream: str | None = None,
+               trace: "object | None" = None, **inputs) -> Future:
         with self._lock:
             state = self.state
             eng = self._engine
@@ -144,7 +163,7 @@ class SupervisedEngine:
                 "retry shortly"
             )
         return eng.submit(priority=priority, units=units, stream=stream,
-                          **inputs)
+                          trace=trace, **inputs)
 
     def warm_async(self, **example) -> None:
         with self._lock:
@@ -277,6 +296,10 @@ class SupervisedEngine:
         with self._lock:
             self.last_stall_ts = time.time()
         log.error("engine %s wedged (%s); quarantining", self.name, reason)
+        # flight recorder: dump the last-N spans + the wedged engine's
+        # queue/in-flight state to a JSONL artifact BEFORE abandon()
+        # fails the stranded futures and mutates the evidence
+        trace.flight_dump(self.name, reason, state=_engine_snapshot(eng))
         self._absorb_counters(eng)
         eng.abandon()
         while not self._stop_evt.is_set():
@@ -287,6 +310,9 @@ class SupervisedEngine:
             if len(self._restart_times) >= self.max_restarts:
                 with self._lock:
                     self._set_state("degraded")
+                trace.flight_dump(
+                    self.name, "restart budget exhausted; degraded",
+                    state=_engine_snapshot(eng))
                 log.error(
                     "engine %s restart budget exhausted (%d rebuilds in "
                     "%.0fs); entering terminal degraded state — process "

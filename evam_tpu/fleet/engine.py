@@ -136,13 +136,15 @@ class FleetEngine:
 
     def submit(self, priority: str = "standard",
                units: int | None = None,
-               stream: str | None = None, **inputs):
+               stream: str | None = None,
+               trace: "object | None" = None, **inputs):
         """Route one item: batch class → mesh engine (big data-parallel
         buckets), everything else → the stream's pinned shard."""
         self._sweep_degraded()
         if priority == "batch" and self._mesh_factory is not None:
             return self._mesh().submit(priority=priority, units=units,
-                                       stream=stream, **inputs)
+                                       stream=stream, trace=trace,
+                                       **inputs)
         label = self._place(stream or "")
         with self._lock:
             eng = self.shards.get(label)
@@ -151,7 +153,7 @@ class FleetEngine:
             with self._lock:
                 eng = self.shards[label]
         return eng.submit(priority=priority, units=units, stream=stream,
-                          **inputs)
+                          trace=trace, **inputs)
 
     def _place(self, stream: str) -> str:
         with self._lock:

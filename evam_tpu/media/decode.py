@@ -101,7 +101,7 @@ class DecodeWorker:
                 self.frames_dropped += dropped
                 metrics.inc(
                     "evam_frames_dropped", dropped,
-                    labels={"stream": self.stream_id})
+                    labels={"stream": self.stream_id, "stage": "decode"})
         else:
             while not self._stop.is_set():
                 try:
@@ -115,10 +115,16 @@ class DecodeWorker:
         while not self._stop.is_set():
             try:
                 self._source = self.source_factory()
+                t_d = time.perf_counter()
                 for ev in self._source.frames():
+                    # time spent inside the source generator ≈ host
+                    # decode cost; rides the event into the frame
+                    # trace's "decode" span (obs/trace.py)
+                    ev.decode_s = time.perf_counter() - t_d
                     if self._stop.is_set():
                         break
                     self._emit(ev)
+                    t_d = time.perf_counter()
                 break  # clean EOS
             except Exception as exc:  # noqa: BLE001 — supervised restart
                 restarts += 1

@@ -33,6 +33,9 @@ class FrameEvent:
                        # 'timestamp' field is ns — charts/README.md:117)
     seq: int
     audio: np.ndarray | None = None  # S16LE mono 16 kHz chunk
+    #: host decode cost in seconds (set by DecodeWorker) — becomes the
+    #: frame trace's "decode" span (obs/trace.py)
+    decode_s: float | None = None
 
 
 class VideoSource(Protocol):

@@ -11,7 +11,7 @@ from __future__ import annotations
 import bisect
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 
 
@@ -66,6 +66,12 @@ METRIC_SPECS: dict[str, tuple[str, tuple[str, ...]]] = {
     "evam_eii_ingest_drops": ("counter", ()),
     # chaos / fault injection
     "evam_faults_injected": ("counter", ("kind",)),
+    # per-frame tracing (obs/trace.py): tail-sampling retention split
+    # by why a frame was kept (error/shed/deadline_miss/slow/sampled)
+    # vs dropped, plus flight-recorder artifacts written per engine
+    "evam_trace_retained": ("counter", ("reason",)),
+    "evam_trace_dropped": ("counter", ()),
+    "evam_flight_dumps": ("counter", ("engine",)),
 }
 
 
@@ -92,10 +98,16 @@ class _Histogram:
     samples: list[float] = field(default_factory=list)
     count: int = 0
     total: float = 0.0
+    #: bounded (value, exemplar) pairs — OpenMetrics exemplars linking
+    #: an observation to a trace id; render() attaches the max-value
+    #: pair to the p99 quantile line
+    exemplars: deque = field(default_factory=lambda: deque(maxlen=8))
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         self.count += 1
         self.total += value
+        if exemplar is not None:
+            self.exemplars.append((value, exemplar))
         if len(self.samples) < self.max_samples:
             bisect.insort(self.samples, value)
         else:
@@ -128,12 +140,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(name, _label_str(labels))] = value
 
-    def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None,
+                exemplar: str | None = None) -> None:
         with self._lock:
             key = (name, _label_str(labels))
             if key not in self._hists:
                 self._hists[key] = _Histogram()
-            self._hists[key].observe(value)
+            self._hists[key].observe(value, exemplar)
 
     def time(self, name: str, labels: dict[str, str] | None = None):
         """Context manager observing elapsed seconds into a histogram."""
@@ -170,6 +183,16 @@ class MetricsRegistry:
         with self._lock:
             hist = self._hists.get((name, _label_str(labels)))
             return hist.quantile(q) if hist else 0.0
+
+    def exemplar(self, name: str, labels: dict[str, str] | None = None
+                 ) -> tuple[float, str] | None:
+        """Slowest recorded (value, exemplar) pair of one histogram —
+        the trace id render() attaches to its p99 line."""
+        with self._lock:
+            hist = self._hists.get((name, _label_str(labels)))
+            if hist is None or not hist.exemplars:
+                return None
+            return max(hist.exemplars)
 
     def quantiles_by_label(self, name: str, q: float) -> dict[str, float]:
         """All labeled series of one histogram → {label_str: quantile}
@@ -215,7 +238,14 @@ class MetricsRegistry:
                 lines.append(f"{name}_sum{labels} {hist.total}")
                 for q in (0.5, 0.9, 0.99):
                     sub = labels[:-1] + "," if labels else "{"
-                    lines.append(f'{name}{sub}quantile="{q}"}} {hist.quantile(q)}')
+                    line = f'{name}{sub}quantile="{q}"}} {hist.quantile(q)}'
+                    if q == 0.99 and hist.exemplars:
+                        # OpenMetrics exemplar: the slowest recorded
+                        # observation names a concrete trace id —
+                        # "what was my p99" becomes one /traces pull.
+                        val, ex = max(hist.exemplars)
+                        line += f' # {{trace_id="{ex}"}} {val}'
+                    lines.append(line)
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

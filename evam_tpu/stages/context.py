@@ -85,6 +85,10 @@ class FrameContext:
     #: engine-backed stages pass it to BatchEngine.submit so the
     #: shared engines schedule per class (evam_tpu/sched/)
     priority: str = "standard"
+    #: per-frame trace handle (obs/trace.py FrameTrace), minted at
+    #: ingest and threaded into engine submits for batch↔frame span
+    #: linkage; None when EVAM_TRACE=off
+    trace: Any | None = None
     #: arbitrary cross-stage scratch (e.g. pending futures)
     scratch: dict[str, Any] = field(default_factory=dict)
 

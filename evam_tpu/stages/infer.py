@@ -14,6 +14,7 @@ parameters (the engine's in-jit NMS uses a permissive floor).
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from concurrent.futures import Future
 
@@ -65,6 +66,19 @@ def _encode_wire(frame_bgr: np.ndarray, wire_format: str) -> np.ndarray:
 #: per-process frame-seed sequence for device_synth mode (the GIL makes
 #: itertools.count().__next__ atomic enough for distinct seeds)
 _SYNTH_SEQ = itertools.count()
+
+
+def _timed_gate_decide(gate, ctx: FrameContext) -> bool:
+    """Run the motion gate's decision with a "gate.decide" span on the
+    frame trace (the gate verdict rides as an attr so a skipped
+    frame's tree explains itself)."""
+    if ctx.trace is None:
+        return gate.decide(ctx.frame)
+    t_g = time.perf_counter()
+    go = gate.decide(ctx.frame)
+    ctx.trace.add_span("gate.decide", t_g, time.perf_counter() - t_g,
+                       {"go": bool(go)})
+    return go
 
 
 def _parse_interval(properties: dict) -> int:
@@ -168,7 +182,8 @@ class DetectStage(AsyncStage):
     def submit(self, ctx: FrameContext) -> Future | None:
         self._count += 1
         if self.gate is not None:
-            if ctx.frame is not None and not self.gate.decide(ctx.frame):
+            if ctx.frame is not None and not _timed_gate_decide(
+                    self.gate, ctx):
                 # motion gate skip: coast the last detections forward
                 ctx.scratch["gate_coast"] = self.gate.consecutive_skips
                 return None
@@ -177,6 +192,7 @@ class DetectStage(AsyncStage):
         return self.engine.submit(
             priority=ctx.priority,
             stream=ctx.stream_id,
+            trace=ctx.trace,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
@@ -280,6 +296,7 @@ class ClassifyStage(AsyncStage):
             priority=ctx.priority,
             units=len(regions),
             stream=ctx.stream_id,
+            trace=ctx.trace,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire),
             boxes=boxes)
 
@@ -354,9 +371,11 @@ class ActionStage(AsyncStage):
         """
         prio = ctx.priority
         stream_id = ctx.stream_id
+        tr = ctx.trace
         enc_fut = self.enc_engine.submit(
             priority=prio,
             stream=ctx.stream_id,
+            trace=tr,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
         outer: Future = Future()
 
@@ -377,6 +396,7 @@ class ActionStage(AsyncStage):
                 # raises RuntimeError when the engine is stopping
                 dec_fut = self.dec_engine.submit(priority=prio,
                                                  stream=stream_id,
+                                                 trace=tr,
                                                  clips=clip)
             except Exception as exc:  # noqa: BLE001 — propagate to the runner
                 outer.set_exception(exc)
@@ -446,6 +466,7 @@ class AudioDetectStage(AsyncStage):
         self._since_last = 0
         return self.engine.submit(priority=ctx.priority,
                                   stream=ctx.stream_id,
+                                  trace=ctx.trace,
                                   windows=self._buffer.copy())
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:
@@ -531,7 +552,8 @@ class FusedDetectClassifyStage(AsyncStage):
     def submit(self, ctx: FrameContext) -> Future | None:
         self._count += 1
         if self.gate is not None:
-            if ctx.frame is not None and not self.gate.decide(ctx.frame):
+            if ctx.frame is not None and not _timed_gate_decide(
+                    self.gate, ctx):
                 ctx.scratch["gate_coast"] = self.gate.consecutive_skips
                 return None
         elif (self._count - 1) % self.interval:
@@ -539,6 +561,7 @@ class FusedDetectClassifyStage(AsyncStage):
         return self.engine.submit(
             priority=ctx.priority,
             stream=ctx.stream_id,
+            trace=ctx.trace,
             frames=_wire_frame(ctx.frame, self.ingest_size, self.wire))
 
     def complete(self, ctx: FrameContext, result: np.ndarray | None) -> list[FrameContext]:

@@ -21,8 +21,10 @@ import time
 
 from evam_tpu.media.source import FrameEvent
 from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs import trace
 from evam_tpu.obs.faults import from_env as faults_from_env
 from evam_tpu.obs.trace import observe_frame_latency, stage_timer
+from evam_tpu.sched.shedder import ShedError
 from evam_tpu.stages.base import AsyncStage, Stage
 from evam_tpu.stages.context import FrameContext
 
@@ -84,7 +86,13 @@ class StreamRunner:
             source_uri=self.source_uri,
             ingest_t=time.perf_counter(),
             priority=self.priority,
+            trace=trace.start_frame(self.stream_id, ev.seq, self.priority),
         )
+        if ctx.trace is not None and ev.decode_s is not None:
+            # decode happened before ingest; backdate the span so the
+            # tree starts where the frame's wall time actually started
+            ctx.trace.add_span("decode", ctx.ingest_t - ev.decode_s,
+                               ev.decode_s)
         if self._faults is not None:
             try:
                 frame = self._faults.apply(ctx.frame)
@@ -115,8 +123,13 @@ class StreamRunner:
             self._parked.popleft()
             try:
                 result = head.future.result() if head.future is not None else None
+                t_c = time.perf_counter()
                 with stage_timer(f"{head.stage.name}.complete"):
                     outs = head.stage.complete(head.ctx, result)
+                if head.ctx.trace is not None:
+                    head.ctx.trace.add_span(
+                        f"stage.{head.stage.name}.complete", t_c,
+                        time.perf_counter() - t_c)
             except Exception as exc:  # noqa: BLE001 — frame-level fault isolation
                 self._handle_error(exc, head.ctx)
                 continue
@@ -124,6 +137,8 @@ class StreamRunner:
                 ctx.stage_index = head.ctx.stage_index + 1
                 if ctx.ingest_t is None:
                     ctx.ingest_t = head.ctx.ingest_t
+                if ctx.trace is None:
+                    ctx.trace = head.ctx.trace
                 self._advance(ctx)
             block = False  # only the head wait is blocking
 
@@ -142,8 +157,12 @@ class StreamRunner:
                 self._parked.append(_Parked(ctx, stage, fut))
                 return
             try:
+                t_s = time.perf_counter()
                 with stage_timer(stage.name):
                     outs = stage.process(ctx)
+                if ctx.trace is not None:
+                    ctx.trace.add_span(f"stage.{stage.name}", t_s,
+                                       time.perf_counter() - t_s)
             except Exception as exc:  # noqa: BLE001
                 self._handle_error(exc, ctx)
                 return
@@ -159,6 +178,8 @@ class StreamRunner:
                 out.stage_index = i + 1
                 if out.ingest_t is None:
                     out.ingest_t = ctx.ingest_t
+                if out.trace is None:
+                    out.trace = ctx.trace
                 self._advance(out)
             return
         self.frames_out += 1
@@ -166,11 +187,17 @@ class StreamRunner:
         if ctx.ingest_t is not None:
             observe_frame_latency(
                 self.stream_id, time.perf_counter() - ctx.ingest_t,
-                priority=ctx.priority)
+                priority=ctx.priority,
+                trace_id=ctx.trace.trace_id if ctx.trace is not None else None)
+        trace.finish_frame(ctx.trace, "ok")
 
     def _handle_error(self, exc: Exception, ctx: FrameContext) -> None:
         self.errors += 1
         metrics.inc("evam_frame_errors", labels={"stream": self.stream_id})
         log.warning("stream %s frame %d error: %s", self.stream_id, ctx.seq, exc)
+        # tail sampling always retains shed/error frames (a shed IS a
+        # deadline miss — the staleness budget expired in queue)
+        trace.finish_frame(ctx.trace,
+                           "shed" if isinstance(exc, ShedError) else "error")
         if self.on_error is not None:
             self.on_error(exc)

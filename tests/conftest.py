@@ -68,9 +68,12 @@ def _reset_fault_memo():
     teardown restoring the env; restore the memo with it so a stale
     injector never leaks into the next test's engines."""
     yield
-    from evam_tpu.obs import faults
+    from evam_tpu.obs import faults, trace
 
     faults.reset_cache()
+    # the trace ring is memoized the same way (obs/trace.py active());
+    # tests that monkeypatch EVAM_TRACE* must not leak a stale ring
+    trace.reset_cache()
 
 
 @pytest.fixture(scope="session")

@@ -191,6 +191,17 @@ class TestRestRouteContracts:
         assert status == 200
         check_golden("route_healthz", health)
 
+    def test_traces_route(self, registry):
+        # reset the memoized ring so the payload is the deterministic
+        # empty-ring shape regardless of what earlier tests traced
+        # (traceEvents stays a list in the golden — fixed key set)
+        from evam_tpu.obs import trace
+        trace.reset_cache()
+        status, data = _request(registry, "GET", "/traces")
+        assert status == 200
+        assert data["enabled"] is True
+        check_golden("route_traces", data)
+
     def test_error_contracts(self, registry):
         status, data = _request(
             registry, "GET", "/pipelines/object_detection/nope")

@@ -167,6 +167,17 @@ def test_soak_drop_attribution(null_engine):
         drop_frac = win["demux_downstream"] / win["decoded"]
         assert drop_frac < 0.10, win
         assert win["publish"] == 0, win
+        # attribution pin: every evam_frames_dropped series must carry
+        # BOTH stream and stage labels (stage ∈ decode|downstream) — a
+        # bare {stream=...} series is an unattributable loss bucket
+        # (regression: media/decode.py once emitted without stage)
+        from evam_tpu.obs.metrics import _parse_labels
+        drop_series = [_parse_labels(ls) for (n, ls)
+                       in list(metrics._counters)
+                       if n == "evam_frames_dropped"]
+        for labels in drop_series:
+            assert set(labels) == {"stream", "stage"}, labels
+            assert labels["stage"] in ("decode", "downstream"), labels
         if null_engine:
             # control: no engines in the chain — any loss or shed here
             # is pure framework/ingest overhead, and there is none

@@ -1,0 +1,280 @@
+"""Tier-1 contract tests for the per-frame tracing layer
+(evam_tpu/obs/trace.py): stage vocabulary pinned to the engine's
+ring-buffer clock, span-tree completeness through the real serving
+path, batch↔frame linkage, tail-based retention, the EVAM_TRACE=off
+no-op guarantee, the bounded ring, the quarantine flight recorder's
+JSONL shape, the Chrome trace-event renderer (tools/trace_dump.py),
+and the OpenMetrics exemplar on the latency p99 line."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from evam_tpu.config.settings import Settings, reset_settings
+from evam_tpu.engine import ringbuf
+from evam_tpu.models import ZOO_SPECS
+from evam_tpu.obs import trace
+from evam_tpu.obs.metrics import metrics
+
+_KNOBS = ("EVAM_TRACE", "EVAM_TRACE_SAMPLE_N", "EVAM_TRACE_RING",
+          "EVAM_TRACE_SLOW_MS", "EVAM_TRACE_FLIGHT_DIR",
+          "EVAM_TRACE_FLIGHT_N")
+
+
+def _fresh(monkeypatch, **env: str) -> None:
+    """Reset the memoized ring under a controlled EVAM_TRACE* env.
+    The autouse conftest fixture restores the memo on teardown."""
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    reset_settings()
+    trace.reset_cache()
+
+
+def test_stage_order_pins_engine_clock():
+    # the span vocabulary IS the engine's per-batch stage clock — a
+    # stage added/renamed in ringbuf.STAGES must update the tracer's
+    # rendering + last_stage attribution in the same change
+    assert trace.STAGE_ORDER == ringbuf.STAGES
+
+
+def test_tail_sampling_retention():
+    ring = trace.TraceRing(sample_n=10_000, slow_ms=1e9)
+    ok = ring.mint("s", 0, "standard")
+    ring.finish(ok, "ok")                 # healthy, not the 1-in-N tick
+    shed = ring.mint("s", 1, "realtime")
+    ring.finish(shed, "shed")             # always retained
+    err = ring.mint("s", 2, "standard")
+    ring.finish(err, "error")             # always retained
+    frames, _, _ = ring.snapshot()
+    assert [f.status for f in frames] == ["shed", "error"]
+    assert ring.retained_count == 2 and ring.dropped_count == 1
+
+    slow = trace.TraceRing(sample_n=10_000, slow_ms=0.0)
+    ft = slow.mint("s", 0, "standard")
+    slow.finish(ft, "ok")                 # dur >= slow_ms → slow tail
+    frames, _, _ = slow.snapshot()
+    assert [f.status for f in frames] == ["ok"]
+
+
+def test_fanout_children_share_one_retention_decision():
+    ring = trace.TraceRing(sample_n=1)
+    ft = ring.mint("s", 0, "standard")
+    ring.finish(ft, "ok")
+    ring.finish(ft, "error")  # late fan-out sibling: no double count
+    assert ring.retained_count == 1
+    assert ft.status == "ok"
+
+
+def test_ring_stays_bounded():
+    ring = trace.TraceRing(sample_n=1, ring=8)
+    for i in range(100):
+        ring.finish(ring.mint("s", i, "standard"), "ok")
+    frames, _, _ = ring.snapshot()
+    assert len(frames) == 8
+    assert ring.retained_count == 100
+    assert [f.seq for f in frames] == list(range(92, 100))
+
+
+def test_trace_off_is_noop(monkeypatch):
+    _fresh(monkeypatch, EVAM_TRACE="off")
+    assert trace.active() is None
+    assert trace.start_frame("s", 0) is None
+    trace.finish_frame(None)              # must not raise
+    trace.batch_begin("e", 0, (), 4, 1, {})
+    trace.batch_complete("e", 0)
+    assert trace.traces_payload() == {
+        "enabled": False, "retained": 0, "dropped": 0,
+        "frames": 0, "batches": 0, "pending": 0, "traceEvents": [],
+    }
+    assert trace.flight_dump("e", "test") is None
+
+
+def test_batch_links_frames_and_chrome_rendering(monkeypatch):
+    _fresh(monkeypatch, EVAM_TRACE_SAMPLE_N="1")
+    ring = trace.active()
+
+    class _Item:
+        def __init__(self, ft):
+            self.trace = ft
+            self.t_submit = ft.t0
+            self.priority = ft.priority
+
+    fts = [trace.start_frame("cam0", i, "realtime") for i in range(3)]
+    items = [_Item(ft) for ft in fts]
+    clock = {s: 0.001 for s in trace.STAGE_ORDER[:-2]}
+    trace.batch_begin("det", 7, items, bucket=4, n=3, clock=clock,
+                      device="cpu:0")
+    trace.batch_complete("det", 7, items, readback_s=0.002,
+                         resolve_s=0.001)
+    for ft in fts:
+        trace.finish_frame(ft, "ok")
+        assert ft.bids == ["det#7"]
+        names = [s[0] for s in ft.spans]
+        assert "sched.queue_wait" in names
+        assert "engine.dispatch" in names
+
+    payload = trace.traces_payload()
+    assert payload["enabled"] and payload["frames"] == 3
+    assert payload["batches"] == 1 and payload["pending"] == 0
+    batch_ev = [e for e in payload["traceEvents"] if e["cat"] == "batch"]
+    assert len(batch_ev) == 1
+    args = batch_ev[0]["args"]
+    # the batch↔frame link: one batch span naming >= 2 member frame
+    # trace ids, with the full stage clock attributed
+    assert args["frames"] == [ft.trace_id for ft in fts]
+    assert set(args["stages"]) == set(trace.STAGE_ORDER)
+    assert args["last_stage"] == "resolve"
+    stage_ev = [e for e in payload["traceEvents"]
+                if e["cat"] == "batch-stage"]
+    assert [e["name"] for e in stage_ev] == list(trace.STAGE_ORDER)
+
+    import trace_dump
+    doc = trace_dump.convert(payload)
+    assert doc["displayTimeUnit"] == "ms"
+    assert trace_dump.linked_batches(doc["traceEvents"]) == 1
+
+
+def test_wedged_batch_last_stage(monkeypatch):
+    _fresh(monkeypatch)
+    clock = {"submit_wait": 0.001, "slot_write": 0.001, "seal": 0.001}
+    trace.batch_begin("det", 3, (), bucket=8, n=2, clock=clock)
+    clock["h2d_issue"] = 0.004  # live mutation AFTER begin is visible
+    _, _, pending = trace.active().snapshot()
+    assert trace.last_stage(trace._clock_stages(pending[0]["clock"])) \
+        == "h2d_issue"
+
+
+def test_flight_dump_shape(monkeypatch, tmp_path):
+    _fresh(monkeypatch, EVAM_TRACE_FLIGHT_DIR=str(tmp_path),
+           EVAM_TRACE_SAMPLE_N="1")
+    ft = trace.start_frame("cam0", 0, "standard")
+    trace.finish_frame(ft, "error")
+    clock = {"submit_wait": 0.001, "h2d_issue": 0.004}
+    trace.batch_begin("det", 11, (), bucket=8, n=2, clock=clock)
+    path = trace.flight_dump("det", "stall watchdog",
+                             state={"queue_depth": 5})
+    assert path is not None and Path(path).parent == tmp_path
+    rows = [json.loads(l) for l in
+            Path(path).read_text().splitlines() if l.strip()]
+    header = rows[0]
+    assert header["type"] == "flight" and header["engine"] == "det"
+    assert isinstance(header["profiler_running"], bool)
+    assert header["state"] == {"queue_depth": 5}
+    batch = [r for r in rows if r["type"] == "batch"]
+    assert len(batch) == 1 and batch[0]["pending"] is True
+    assert batch[0]["last_stage"] == "h2d_issue"
+    assert "clock" not in batch[0]
+    frame = [r for r in rows if r["type"] == "frame"]
+    assert len(frame) == 1 and frame[0]["status"] == "error"
+
+    # the flight artifact renders to Chrome events too
+    import trace_dump
+    events = trace_dump.events_from_flight(rows)
+    assert any(e["cat"] == "batch" for e in events)
+
+
+def test_runner_backdates_decode_span(monkeypatch):
+    """StreamRunner.feed mints the trace and backdates a ``decode``
+    span from the event's host decode cost, then wraps every sync
+    stage in a ``stage.<name>`` span and finishes ``ok``."""
+    import numpy as np
+
+    from evam_tpu.media.source import FrameEvent
+    from evam_tpu.stages.runner import StreamRunner
+
+    class _Passthrough:
+        name = "resize"
+        is_async = False
+
+        def process(self, ctx):
+            return [ctx]
+
+    _fresh(monkeypatch, EVAM_TRACE_SAMPLE_N="1")
+    runner = StreamRunner("cam0", [_Passthrough()])
+    ev = FrameEvent(frame=np.zeros((8, 8, 3), np.uint8), pts_ns=0,
+                    seq=0, decode_s=0.005)
+    runner.feed(ev)
+    runner.drain()
+    frames, _, _ = trace.active().snapshot()
+    assert len(frames) == 1 and frames[0].status == "ok"
+    spans = frames[0].spans
+    assert [s[0] for s in spans] == ["decode", "stage.resize"]
+    dec_t0, dec_dur = spans[0][1], spans[0][2]
+    assert dec_dur == 0.005
+    assert dec_t0 <= spans[1][1]  # decode precedes the chain
+
+
+def test_exemplar_on_p99_line(monkeypatch):
+    _fresh(monkeypatch)
+    # the latency histogram is process-global and other tests land
+    # their own exemplars; the renderer surfaces the SLOWEST recorded
+    # pair, so observe one slower than any plausible real latency
+    trace.observe_frame_latency("cam0", 86400.0, priority="realtime",
+                                trace_id="evam-test-42")
+    out = metrics.render()
+    p99 = [l for l in out.splitlines()
+           if l.startswith("evam_frame_latency_seconds{")
+           and 'quantile="0.99"' in l and "class" not in l]
+    assert p99 and '# {trace_id="evam-test-42"} 86400.0' in p99[0]
+
+
+def test_span_tree_through_serving_path(monkeypatch, eight_devices):
+    """End-to-end: a synthetic stream through PipelineRegistry →
+    StreamRunner → shared BatchEngine leaves complete span trees —
+    decode, per-stage, queue-wait and dispatch — all linked to the
+    batch records that served them."""
+    from evam_tpu.engine import EngineHub
+    from evam_tpu.models import ModelRegistry
+    from evam_tpu.parallel import build_mesh
+    from evam_tpu.server.registry import PipelineRegistry
+
+    _fresh(monkeypatch, EVAM_TRACE_SAMPLE_N="1")
+    small = {k: (64, 64) for k in ZOO_SPECS}
+    small["audio_detection/environment"] = (1, 1600)
+    narrow = {k: 8 for k in ZOO_SPECS}
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+    hub = EngineHub(
+        ModelRegistry(dtype="float32", input_overrides=small,
+                      width_overrides=narrow),
+        plan=build_mesh(), max_batch=8, deadline_ms=4.0)
+    registry = PipelineRegistry(settings, hub=hub)
+    try:
+        inst = registry.start_instance(
+            "object_detection", "person_vehicle_bike",
+            {"source": {"uri": "synthetic://96x96@30?count=12&seed=1",
+                        "type": "uri"},
+             "destination": {"metadata": {"type": "null"}}})
+        inst.wait(timeout=180)
+        assert inst.state.value == "COMPLETED", inst.error
+    finally:
+        registry.stop_all()
+
+    frames, batches, pending = trace.active().snapshot()
+    done = [f for f in frames if f.status == "ok" and f.bids]
+    assert done, [f.to_dict() for f in frames]
+    ft = done[-1]
+    names = [s[0] for s in ft.spans]
+    assert any(n.startswith("stage.") for n in names)
+    assert "sched.queue_wait" in names and "engine.dispatch" in names
+    # every bid a frame carries resolves to a recorded batch that
+    # names the frame back — the link is bidirectional
+    by_bid = {f"{r['engine']}#{r['bid']}": r for r in batches + pending}
+    for bid in ft.bids:
+        assert ft.trace_id in by_bid[bid]["frames"]
+    served = by_bid[ft.bids[0]]
+    assert served["stages"], served
+    assert trace.last_stage(served["stages"]) == "resolve"
+    # spans nest inside the frame's lifetime, orderable for rendering
+    t_end = time.perf_counter()
+    for (_, t0, dur, _) in ft.spans:
+        assert ft.t0 - 1.0 <= t0 <= t_end and 0.0 <= dur < 300.0

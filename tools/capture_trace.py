@@ -9,6 +9,14 @@ tables are built from. Run on the real chip:
 The trace directory is also summarized to stdout when
 tensorflow/tensorboard parsing is available; otherwise inspect with
 `tensorboard --logdir <outdir>` elsewhere.
+
+``--from-flight-recorder <flight.jsonl>`` replays the batch shape a
+quarantine dump recorded (evam_tpu/obs/trace.py flight_dump): the
+wedged batch's bucket size parameterizes the capture, so the device
+timeline profiles exactly the batch geometry that wedged. Prefers the
+pending (in-flight at quarantine) batch row; every dump's header also
+says whether the profiler server was up (``profiler_running``) at the
+moment of the wedge.
 """
 
 from __future__ import annotations
@@ -26,12 +34,50 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def pick_flight_batch(path: str) -> dict | None:
+    """The batch row to replay from a flight-recorder JSONL: the
+    in-flight (wedged) batch when there is one, else the last
+    completed batch."""
+    import json
+
+    pending, done = [], []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            if row.get("type") != "batch":
+                continue
+            (pending if row.get("pending") else done).append(row)
+    if pending:
+        return pending[-1]
+    return done[-1] if done else None
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--outdir", default="/tmp/evam_trace")
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--from-flight-recorder", metavar="FLIGHT_JSONL",
+                   help="replay the batch shape recorded by a "
+                        "quarantine flight dump (bucket size of the "
+                        "wedged batch parameterizes the capture)")
     args = p.parse_args()
+
+    if args.from_flight_recorder:
+        row = pick_flight_batch(args.from_flight_recorder)
+        if row is None:
+            print("no batch rows in flight dump; nothing to replay",
+                  file=sys.stderr)
+            return 1
+        args.batch = int(row.get("bucket") or row.get("n") or args.batch)
+        print(
+            f"replaying flight batch: engine={row.get('engine')} "
+            f"bid={row.get('bid')} bucket={args.batch} "
+            f"pending={row.get('pending')} "
+            f"last_stage={row.get('last_stage')}",
+            file=sys.stderr)
 
     import jax
     import jax.numpy as jnp

@@ -9,7 +9,10 @@ contract the EngineSupervisor exists for:
 * injected ``wedge`` faults trip the stall watchdog, the supervisor
   quarantines + rebuilds the engine, and serving resumes — within the
   restart budget (no engine ends the run ``degraded``);
-* the readiness payload (/healthz shape) is back to healthy at the end.
+* the readiness payload (/healthz shape) is back to healthy at the end;
+* every quarantine leaves a flight-recorder JSONL (obs/trace.py)
+  whose pending-batch row names the wedged batch's last completed
+  stage — the post-mortem artifact the tracing PR exists for.
 
 Usage (defaults are the CI-adjacent quick shape):
 
@@ -68,6 +71,17 @@ def run_soak(
     # plain (not first-batch compile grace) budget to the wedge
     os.environ["EVAM_FAULT_INJECT"] = ""
     faults.reset_cache()
+    # flight recorder lands in a per-run dir so the post-wedge
+    # assertion reads only THIS soak's dumps
+    import tempfile
+
+    from evam_tpu.config.settings import reset_settings
+    from evam_tpu.obs import trace
+
+    flight_dir = tempfile.mkdtemp(prefix="evam-flight-")
+    os.environ["EVAM_TRACE_FLIGHT_DIR"] = flight_dir
+    reset_settings()
+    trace.reset_cache()
     small = {k: (64, 64) for k in ZOO_SPECS}
     small["audio_detection/environment"] = (1, 1600)
     narrow = {k: 8 for k in ZOO_SPECS}
@@ -144,15 +158,37 @@ def run_soak(
             "evam_faults_injected", labels={"kind": "wedge"}) - wedges0
     finally:
         registry.stop_all()
+    # flight-recorder artifact check: every quarantine dumped a JSONL
+    # and the wedged (pending at quarantine) batch row names its last
+    # completed engine stage
+    flight_files = sorted(Path(flight_dir).glob("flight-*.jsonl"))
+    flight_last_stage = None
+    flight_pending_batches = 0
+    for f in flight_files:
+        for line in f.read_text(encoding="utf-8").splitlines():
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            if row.get("type") == "batch" and row.get("pending"):
+                flight_pending_batches += 1
+                if row.get("last_stage"):
+                    flight_last_stage = row["last_stage"]
+    flight_ok = (min_restarts == 0
+                 or (bool(flight_files) and flight_last_stage is not None))
     ok = (
         all(s == "COMPLETED" for s in states)
         and not degraded
         and restarts >= min_restarts
         and ready.get("restarting", 0) == 0
         and frames_out > 0
+        and flight_ok
     )
     return {
         "ok": ok,
+        "flight_dumps": len(flight_files),
+        "flight_pending_batches": flight_pending_batches,
+        "flight_last_stage": flight_last_stage,
+        "flight_dir": flight_dir,
         "streams": streams,
         "states": states,
         "frames_out": frames_out,
