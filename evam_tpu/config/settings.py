@@ -73,6 +73,12 @@ class TPUSettings(BaseModel):
     #: path, kept byte-identical for A/B (tools/bench_transfer.py).
     #: EVAM_SERIALIZE_COMPILE=1 forces inline regardless.
     transfer: Literal["pipelined", "inline"] = "pipelined"
+    #: pipelined-transfer upload-queue depth: how many staged batches
+    #: may sit between the dispatcher's h2d_issue and the launcher.
+    #: 2 is the measured sweet spot at boot; the control plane
+    #: (EVAM_TUNE=on) retunes it live from the h2d_wait/launch ratio.
+    #: Setting it explicitly pins it against the controller.
+    transfer_depth: int = 2
     #: ragged batching (engine/ragged.py): "packed" packs classify
     #: region sets into one fixed masked-compute device shape (row
     #: length/offset vectors, Ragged Paged Attention style) and
@@ -159,6 +165,40 @@ class TraceSettings(BaseModel):
     flight_n: int = 256
 
 
+class TuneSettings(BaseModel):
+    """Self-tuning control plane knobs (evam_tpu/control/): a feedback
+    controller on the watchdog cadence that retunes the registered
+    serving knobs — batch-formation deadlines, batch cap, transfer
+    upload-queue depth, gate thresholds, admission utilization /
+    capacity, staleness budgets — from the live stage clock and queue
+    gauges. ``EVAM_TUNE=off`` (default until a TPU window proves it)
+    disables the whole layer — byte-identical A/B
+    (tools/bench_tune.py), same discipline as EVAM_TRANSFER /
+    EVAM_GATE / EVAM_TRACE. Every knob the controller manages stays
+    pinnable via its existing env var: an explicitly-set key is
+    clamped out of the control loop."""
+
+    enabled: bool = False
+    #: controller tick period in seconds (the hub watchdog cadence is
+    #: stall_timeout_s/4; the controller runs its own clock so tests
+    #: and benches can spin it fast)
+    interval_s: float = 2.0
+    #: bounded log of the last N control actions, served on /scheduler
+    actions: int = 32
+    #: anti-flap damping: a rule must agree for this many CONSECUTIVE
+    #: ticks before its action is applied
+    damping: int = 3
+    #: per-knob cooldown in ticks after an applied action (hysteresis:
+    #: a knob that just moved must re-earn its next move)
+    cooldown: int = 2
+    #: utilization above which the controller tightens (gate
+    #: thresholds up, staleness budgets down, admission ceiling down)
+    util_hi: float = 0.80
+    #: utilization below which it relaxes back toward the static
+    #: operating point (dead band between util_lo and util_hi)
+    util_lo: float = 0.50
+
+
 class Settings(BaseModel):
     """Flat service settings resolved from env + optional config file."""
 
@@ -201,6 +241,7 @@ class Settings(BaseModel):
     tpu: TPUSettings = Field(default_factory=TPUSettings)
     sched: SchedSettings = Field(default_factory=SchedSettings)
     trace: TraceSettings = Field(default_factory=TraceSettings)
+    tune: TuneSettings = Field(default_factory=TuneSettings)
 
     @classmethod
     def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
@@ -248,6 +289,7 @@ class Settings(BaseModel):
             "EVAM_ENGINE_RESTART_BACKOFF_S": ("restart_backoff_s", float),
             "EVAM_FIRST_BATCH_GRACE": ("first_batch_grace", float),
             "EVAM_TRANSFER": ("transfer", str),
+            "EVAM_TRANSFER_DEPTH": ("transfer_depth", int),
             "EVAM_RAGGED": ("ragged", str),
             "EVAM_RAGGED_UNIT_BUDGET": ("ragged_unit_budget", int),
             "EVAM_FLEET": ("fleet", str),
@@ -292,6 +334,21 @@ class Settings(BaseModel):
             for var, (key, conv) in trace_mapping.items():
                 if var in env:
                     trace[key] = conv(env[var])
+
+        tune = data.setdefault("tune", {})
+        tune_mapping = {
+            "EVAM_TUNE": ("enabled", _parse_bool),
+            "EVAM_TUNE_INTERVAL_S": ("interval_s", float),
+            "EVAM_TUNE_ACTIONS": ("actions", int),
+            "EVAM_TUNE_DAMPING": ("damping", int),
+            "EVAM_TUNE_COOLDOWN": ("cooldown", int),
+            "EVAM_TUNE_UTIL_HI": ("util_hi", float),
+            "EVAM_TUNE_UTIL_LO": ("util_lo", float),
+        }
+        if isinstance(tune, dict):
+            for var, (key, conv) in tune_mapping.items():
+                if var in env:
+                    tune[key] = conv(env[var])
         return cls.model_validate(data)
 
 

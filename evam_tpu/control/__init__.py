@@ -1,0 +1,45 @@
+"""Workload-aware self-tuning control plane (OCTOPINF, PAPERS.md).
+
+Every serving knob the stack grew — batch buckets, transfer depth,
+priority deadlines, staleness budgets, gate thresholds, admission
+utilization — used to be a static env var tuned once at boot, while
+the live stage clock, queue gauges, and per-frame traces already
+measure exactly the signals needed to retune them. This package
+closes the loop:
+
+- ``state``: the memoized live :class:`OperatingPoint` — one
+  None-check on every hot path (same discipline as
+  ``faults.current()`` / ``trace.active()``), swapped wholesale by
+  the controller each tick. Consumers (engine dispatch loops, the
+  motion gate, admission, the shedder) *pull* scalar setpoints;
+  structural knobs (upload-queue depth) are *pushed* via
+  ``EngineHub.retune``.
+- ``controller``: the feedback loop itself — per-signal control laws
+  with anti-flap damping and per-knob cooldowns, clamped away from
+  any knob the operator pinned via its env var.
+
+``EVAM_TUNE=off`` (the default) is byte-identical to the static
+configuration (tools/bench_tune.py gates identity + overhead in CI);
+``GET /scheduler`` reports the current operating point, the signals
+that produced it, and the last N actions with reasons.
+"""
+
+from evam_tpu.control.controller import TuneController
+from evam_tpu.control.state import (
+    OperatingPoint,
+    TuneState,
+    active,
+    current_op,
+    disabled_snapshot,
+    reset_cache,
+)
+
+__all__ = [
+    "OperatingPoint",
+    "TuneController",
+    "TuneState",
+    "active",
+    "current_op",
+    "disabled_snapshot",
+    "reset_cache",
+]

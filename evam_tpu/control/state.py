@@ -1,0 +1,186 @@
+"""Live operating point: the control plane's hot-path surface.
+
+The controller (``control/controller.py``) runs on its own cadence
+and swaps a frozen :class:`OperatingPoint` into the process-wide
+:class:`TuneState` each tick. Hot paths — engine dispatch loops, the
+motion gate, admission, the shedder — read it through
+:func:`current_op`, which memoizes the ``EVAM_TUNE`` decision the
+same way ``faults.current()`` / ``trace.active()`` do: with the
+controller off (the default) every consult is one global load and a
+``None`` check, and behavior is byte-identical to the static
+configuration (tools/bench_tune.py gates both in CI).
+
+Neutral field values (``1.0`` scales, ``0`` overrides) mean "use the
+static setting" — a fresh ``TuneState`` therefore serves exactly the
+boot configuration until the controller's first action, and pinned
+knobs simply never leave neutral. Because consumers pull from this
+one process-wide object, supervisor rebuilds and fleet re-placements
+inherit the current setpoints for free; the only pushed knob
+(upload-queue depth) is re-read at engine construction and re-pushed
+by the controller on its next tick.
+
+No environment reads here (evamlint knobs pass): configuration
+arrives through ``config/settings.py`` only.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class OperatingPoint:
+    """One immutable set of controller setpoints. Scales default to
+    1.0 and absolute overrides to 0 ("no override"), so the default
+    instance is behavior-neutral by construction."""
+
+    #: multiplier on batch-formation deadlines (engine-level and
+    #: per-class): >1 fills bigger buckets under pressure, <1 cuts
+    #: formation latency when there is headroom
+    deadline_scale: float = 1.0
+    #: cap on items collected per batch (0 = engine max_batch) —
+    #: shifts dispatch toward the bucket rung the demand mix fills
+    batch_cap: int = 0
+    #: pipelined-transfer upload-queue depth (0 = static
+    #: EVAM_TRANSFER_DEPTH), derived from the h2d_wait/launch ratio
+    transfer_depth: int = 0
+    #: multiplier on motion-gate thresholds: >1 gates harder as
+    #: utilization climbs, 1.0 = the configured thresholds
+    gate_scale: float = 1.0
+    #: admission utilization ceiling override (0 = static
+    #: EVAM_SCHED_ADMIT_UTIL)
+    admit_util: float = 0.0
+    #: per-tick EWMA of live serving capacity in frames/s (0 = let
+    #: admission derive capacity from raw engine stats at admit time)
+    capacity_fps: float = 0.0
+    #: multiplier on per-class staleness budgets: <1 sheds earlier
+    #: under sustained overload
+    staleness_scale: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {
+            "deadline_scale": self.deadline_scale,
+            "batch_cap": self.batch_cap,
+            "transfer_depth": self.transfer_depth,
+            "gate_scale": self.gate_scale,
+            "admit_util": self.admit_util,
+            "capacity_fps": self.capacity_fps,
+            "staleness_scale": self.staleness_scale,
+        }
+
+
+#: fixed signal vocabulary reported on /scheduler (golden-pinned):
+#: the measurements the controller's last tick acted on
+ZERO_SIGNALS = {
+    "utilization": 0.0,
+    "queue_depth": 0.0,
+    "oldest_age_s": 0.0,
+    "h2d_wait_ms": 0.0,
+    "launch_ms": 0.0,
+    "shed_delta": 0.0,
+    "skip_fps": 0.0,
+    "batch_p95": 0.0,
+    "capacity_fps": 0.0,
+    "demand_fps": 0.0,
+}
+
+
+class TuneState:
+    """Process-wide controller state: the live operating point, the
+    signals that produced it, and a bounded action log. The ``op``
+    reference is swapped wholesale (reads are a GIL-atomic load, no
+    lock on the hot path); everything else mutates under the lock."""
+
+    SHARED_UNDER = {
+        "op": "_lock",
+        "ticks": "_lock",
+        "signals": "_lock",
+        "_actions": "_lock",
+    }
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self.op = OperatingPoint()
+        self.ticks = 0
+        self.signals = dict(ZERO_SIGNALS)
+        self._actions: deque = deque(maxlen=max(1, int(cfg.actions)))
+
+    def install(self, op: OperatingPoint, signals: dict) -> None:
+        """Publish one tick's outcome (controller thread only)."""
+        with self._lock:
+            self.op = op
+            self.ticks += 1
+            self.signals = {k: float(signals.get(k, 0.0))
+                            for k in ZERO_SIGNALS}
+
+    def record(self, action: dict) -> None:
+        with self._lock:
+            self._actions.append(dict(action))
+
+    def snapshot(self) -> dict:
+        """Fixed-shape /scheduler payload (tests/golden/route_scheduler
+        pins it; keep key sets stable)."""
+        with self._lock:
+            op = self.op
+            ticks = self.ticks
+            signals = dict(self.signals)
+            actions = [dict(a) for a in self._actions]
+        return {
+            "enabled": True,
+            "ticks": ticks,
+            "operating_point": op.to_dict(),
+            "signals": signals,
+            "actions": actions,
+        }
+
+
+def disabled_snapshot() -> dict:
+    """The same /scheduler shape with the controller off: neutral
+    operating point, zero signals, empty action log."""
+    return {
+        "enabled": False,
+        "ticks": 0,
+        "operating_point": OperatingPoint().to_dict(),
+        "signals": dict(ZERO_SIGNALS),
+        "actions": [],
+    }
+
+
+#: memoized EVAM_TUNE decision — (state,) once resolved, None before.
+#: Same shape as obs/trace.py: the tuple wrapper distinguishes
+#: "resolved to disabled" from "not yet resolved".
+_resolved: tuple[TuneState | None] | None = None
+
+
+def active() -> TuneState | None:
+    """The process TuneState, or None with EVAM_TUNE=off. Memoized:
+    the off path costs one global load per consult."""
+    if _resolved is not None:
+        return _resolved[0]
+    return _resolve()
+
+
+def _resolve() -> TuneState | None:
+    global _resolved
+    from evam_tpu.config.settings import get_settings
+
+    cfg = get_settings().tune
+    state = TuneState(cfg) if cfg.enabled else None
+    _resolved = (state,)
+    return state
+
+
+def current_op() -> OperatingPoint | None:
+    """The live operating point, or None with EVAM_TUNE=off — the
+    one-line consult every hot path uses."""
+    state = active()
+    return None if state is None else state.op
+
+
+def reset_cache() -> None:
+    """Drop the memo (tests / bench A-B flips)."""
+    global _resolved
+    _resolved = None

@@ -69,6 +69,7 @@ from typing import Callable
 import jax
 import numpy as np
 
+from evam_tpu.control.state import current_op
 from evam_tpu.engine import devlock
 from evam_tpu.engine.ragged import (
     RaggedSpec,
@@ -105,6 +106,18 @@ class _WorkItem:
     #: item's frame span tree to the batch it rides in; None when
     #: tracing is off or the caller has no frame context
     trace: object | None = None
+
+
+class _TunableQueue(queue.Queue):
+    """``queue.Queue`` whose bound is retunable live (the control
+    plane's upload-queue depth knob). Growing the bound wakes blocked
+    putters immediately; shrinking applies lazily as the consumer
+    drains below the new bound — no staged batch is ever dropped."""
+
+    def set_depth(self, n: int) -> None:
+        with self.mutex:
+            self.maxsize = max(1, int(n))
+            self.not_full.notify_all()
 
 
 def _safe_set_result(fut: Future, value) -> None:
@@ -235,6 +248,7 @@ class BatchEngine:
         ragged: str | None = None,
         ragged_spec: RaggedSpec | None = None,
         fleet_local: bool = False,
+        transfer_depth: int | None = None,
     ):
         self.name = name
         self.plan = plan
@@ -451,12 +465,19 @@ class BatchEngine:
         self._queue: queue.Queue[_WorkItem | None] = queue.Queue()
         self._done: queue.Queue[tuple | None] = queue.Queue()
         #: pipelined transfer only: sealed batches whose H2D copy has
-        #: been issued, awaiting launch. Bounded at 2 — device-side
+        #: been issued, awaiting launch. Default depth 2 — device-side
         #: double buffering (one batch uploading while one launches);
-        #: deeper prefetch only extends slot lifetime without adding
-        #: overlap, and the ring depth (max_in_flight + 1) already
-        #: bounds how many staged blocks can exist at once.
-        self._upload_q: queue.Queue[tuple | None] = queue.Queue(maxsize=2)
+        #: EVAM_TRANSFER_DEPTH pins it, and the control plane
+        #: (EVAM_TUNE=on) retunes it live from the h2d_wait/launch
+        #: ratio via retune(). Construction reads the live operating
+        #: point first so a supervisor rebuild resumes at the
+        #: controller's current depth, not the boot value.
+        op = current_op()
+        live_depth = op.transfer_depth if op is not None else 0
+        self.transfer_depth = max(1, int(live_depth
+                                         or (transfer_depth or 2)))
+        self._upload_q: _TunableQueue = _TunableQueue(
+            maxsize=self.transfer_depth)
         self._warm_lock = threading.Lock()
         self._warming = False
         #: set when background warmup finishes (or fails)
@@ -619,6 +640,17 @@ class BatchEngine:
         if self._shedder is None:
             return {}
         return dict(self._shedder.counts)
+
+    def retune(self, op) -> None:
+        """Apply the controller's operating point to this engine's
+        structural knobs (control-plane push path — evam_tpu/control/).
+        Scalar setpoints (deadline scale, batch cap) are pulled per
+        dispatch instead, so rebuilds inherit them for free; only the
+        upload-queue depth needs an explicit resize."""
+        depth = int(op.transfer_depth or 0)
+        if depth and depth != self.transfer_depth:
+            self.transfer_depth = max(1, depth)
+            self._upload_q.set_depth(self.transfer_depth)
 
     def warmup(self) -> None:
         """Compile every bucket size ahead of traffic."""
@@ -1146,8 +1178,17 @@ class BatchEngine:
             cls = cq.pick(timeout=0.05)
             if cls is None:
                 continue
-            items = cq.collect(cls, self.max_batch,
-                               self.sched.deadline_s(cls))
+            # live setpoints (control plane): one None-check with
+            # EVAM_TUNE=off — deadlines scale, formation caps at the
+            # demanded bucket rung
+            op = current_op()
+            cap = self.max_batch
+            deadline = self.sched.deadline_s(cls)
+            if op is not None:
+                if op.batch_cap:
+                    cap = min(cap, op.batch_cap)
+                deadline *= op.deadline_scale
+            items = cq.collect(cls, cap, deadline)
             # the batch-formation wait itself can age items past
             # budget (and a realtime burst can delay a picked batch
             # class) — filter the formed batch too
@@ -1217,7 +1258,10 @@ class BatchEngine:
         no stack, no pad concat, no per-batch allocation."""
         bucket_fn = self._bucket_ragged if self._packed else self._bucket
         while True:
-            sealed = self._ring.next_batch(self.deadline_s, bucket_fn)
+            op = current_op()
+            deadline = (self.deadline_s * op.deadline_scale
+                        if op is not None else self.deadline_s)
+            sealed = self._ring.next_batch(deadline, bucket_fn)
             if sealed is None:
                 if self._stop.is_set():
                     break
@@ -1245,9 +1289,16 @@ class BatchEngine:
                 continue
             if first is None:
                 break
+            op = current_op()
+            cap = self.max_batch
+            deadline_s = self.deadline_s
+            if op is not None:
+                if op.batch_cap:
+                    cap = min(cap, op.batch_cap)
+                deadline_s *= op.deadline_scale
             items = [first]
-            deadline = time.perf_counter() + self.deadline_s
-            while len(items) < self.max_batch:
+            deadline = time.perf_counter() + deadline_s
+            while len(items) < cap:
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
                     break

@@ -55,6 +55,7 @@ class EngineHub:
         first_batch_grace: float = 10.0,
         sched: SchedConfig | None = None,
         transfer: str | None = None,
+        transfer_depth: int = 0,
         ragged: str | None = None,
         ragged_unit_budget: int = 0,
         fleet: str | None = None,
@@ -100,6 +101,13 @@ class EngineHub:
         #: the factory closure carries it, so a supervisor-rebuilt
         #: engine keeps its transfer mode. None = engine reads the env.
         self.transfer = transfer
+        #: pipelined upload-queue bound (EVAM_TRANSFER_DEPTH): the
+        #: static boot value; the control plane (evam_tpu/control/)
+        #: retunes the live bound through ``retune``. Part of the
+        #: rebuild recipe — but BatchEngine construction consults the
+        #: live operating point first, so a supervisor rebuild resumes
+        #: at the controller's current depth, not this boot value.
+        self.transfer_depth = transfer_depth
         #: ragged batching (engine/ragged.py, EVAM_RAGGED): "packed"
         #: gives classify-family engines masked region packing (the
         #: ragged builder + a RaggedSpec'd staging ring) and every
@@ -268,6 +276,7 @@ class EngineHub:
                     first_batch_grace=self.first_batch_grace,
                     sched=self.sched,
                     transfer=self.transfer,
+                    transfer_depth=self.transfer_depth or None,
                     ragged=self.ragged,
                     ragged_spec=ragged_spec,
                     fleet_local=fleet_local,
@@ -539,6 +548,21 @@ class EngineHub:
             for label, n in s["streams"].items():
                 out["streams"][label] = out["streams"].get(label, 0) + n
         return out
+
+    def retune(self, op) -> None:
+        """Push the controller's operating point to every cached engine
+        (evam_tpu/control/). Only structural knobs travel this path —
+        scalar setpoints are pulled per dispatch via
+        ``control.state.current_op``. SupervisedEngine delegates to its
+        live BatchEngine; FleetEngine broadcasts to shards + mesh."""
+        with self._lock:
+            engines = list(self._engines.values())
+        for e in engines:
+            try:
+                e.retune(op)
+            except Exception:  # noqa: BLE001 — engine mid-teardown
+                log.debug("retune skipped for a stopping engine",
+                          exc_info=True)
 
     def stop(self) -> None:
         with self._lock:

@@ -331,6 +331,16 @@ class FleetEngine:
         for e in shards:
             e.warm_async(**example)
 
+    def retune(self, op) -> None:
+        """Broadcast the controller's operating point to every shard
+        plus the mesh twin (evam_tpu/control/): the fleet must run one
+        operating point, not whichever shard __getattr__ answers from."""
+        for e in self._members():
+            try:
+                e.retune(op)
+            except Exception:  # noqa: BLE001 — shard mid-teardown
+                pass
+
     def abandon(self) -> None:
         for e in self._members():
             try:

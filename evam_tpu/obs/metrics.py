@@ -72,6 +72,12 @@ METRIC_SPECS: dict[str, tuple[str, tuple[str, ...]]] = {
     "evam_trace_retained": ("counter", ("reason",)),
     "evam_trace_dropped": ("counter", ()),
     "evam_flight_dumps": ("counter", ("engine",)),
+    # self-tuning control plane (evam_tpu/control/): controller ticks,
+    # applied retune actions per knob, and the current operating-point
+    # setpoint per knob (the same values /scheduler reports)
+    "evam_tune_ticks": ("counter", ()),
+    "evam_tune_actions": ("counter", ("knob",)),
+    "evam_tune_setpoint": ("gauge", ("knob",)),
 }
 
 

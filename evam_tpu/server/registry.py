@@ -17,6 +17,7 @@ from pathlib import Path
 from typing import Any
 
 from evam_tpu.config import Settings
+from evam_tpu.control import state as control_state
 from evam_tpu.engine.hub import EngineHub
 from evam_tpu.graph import PipelineLoader, resolve_parameters
 from evam_tpu.models.registry import ModelRegistry
@@ -77,6 +78,7 @@ class PipelineRegistry:
                 first_batch_grace=settings.tpu.first_batch_grace,
                 sched=sched_cfg if sched_cfg.enabled else None,
                 transfer=settings.tpu.transfer,
+                transfer_depth=settings.tpu.transfer_depth,
                 ragged=settings.tpu.ragged,
                 ragged_unit_budget=settings.tpu.ragged_unit_budget,
                 fleet=settings.tpu.fleet,
@@ -90,6 +92,21 @@ class PipelineRegistry:
         self.sched_cfg = (getattr(hub, "sched", None)
                           or SchedConfig.disabled())
         self.admission = AdmissionController(hub, self.sched_cfg)
+        #: self-tuning control plane (evam_tpu/control/, EVAM_TUNE):
+        #: a feedback loop on the live signals (stage clock, queue
+        #: gauges, gate skip rate, admission utilization, shed counts)
+        #: continuously retuning deadlines, bucket caps, transfer
+        #: depth, gate thresholds and admission headroom. Off (the
+        #: default) this is one memoized None-check and the server is
+        #: byte-identical to the static configuration.
+        self.tuner = None
+        tune_state = control_state.active()
+        if tune_state is not None:
+            from evam_tpu.control import TuneController
+
+            self.tuner = TuneController(
+                hub, tune_state, admission=self.admission)
+            self.tuner.start()
         #: shared decode pool (opt-in, EVAM_DECODE_POOL_WORKERS>0):
         #: bounds total decode threads across all instances
         self.decode_pool = None
@@ -396,6 +413,13 @@ class PipelineRegistry:
         out["fleet"] = (fleet_fn() if fleet_fn is not None else {
             "mode": "off", "shards": 0, "degraded_shards": 0,
             "rebalances": 0, "streams": {}})
+        # self-tuning operating point (evam_tpu/control/): the current
+        # setpoints, the signals that produced them, and the last N
+        # control actions with reasons — the same fixed shape (with
+        # zeros and an empty action log) when EVAM_TUNE=off
+        st = control_state.active()
+        out["tuning"] = (st.snapshot() if st is not None
+                         else control_state.disabled_snapshot())
         return out
 
     def stop_all(self) -> int:
@@ -451,6 +475,8 @@ class PipelineRegistry:
             if not i.deleted
             and i.state not in (InstanceState.COMPLETED, InstanceState.ERROR)
         ])
+        if self.tuner is not None:
+            self.tuner.stop()
         self.hub.stop()
         return leaked
 

@@ -68,12 +68,17 @@ def _reset_fault_memo():
     teardown restoring the env; restore the memo with it so a stale
     injector never leaks into the next test's engines."""
     yield
+    from evam_tpu.control import state as control_state
     from evam_tpu.obs import faults, trace
 
     faults.reset_cache()
     # the trace ring is memoized the same way (obs/trace.py active());
     # tests that monkeypatch EVAM_TRACE* must not leak a stale ring
     trace.reset_cache()
+    # ... and so is the control plane's TuneState (control/state.py):
+    # a leaked live operating point would silently retune every
+    # engine built by the next test
+    control_state.reset_cache()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,409 @@
+"""Tier-1 contract tests for the self-tuning control plane
+(evam_tpu/control/): per-signal control laws, anti-flap damping and
+per-knob cooldowns, clamp-to-pinned-knob, the EVAM_TUNE=off
+byte-identity guarantee at hub level, rebuild inheritance of the
+live operating point, and the /scheduler tuning block's fixed shape.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from evam_tpu.config.settings import TuneSettings, reset_settings
+from evam_tpu.control import state as control_state
+from evam_tpu.control.controller import TuneController
+from evam_tpu.control.state import OperatingPoint, TuneState, ZERO_SIGNALS
+from evam_tpu.engine.batcher import BatchEngine, _TunableQueue
+
+pytestmark = pytest.mark.control
+
+
+def _sig(**kw) -> dict:
+    s = dict(ZERO_SIGNALS)
+    s.update(kw)
+    return s
+
+
+class _FakeHub:
+    """Duck-typed hub: stats rows + shed totals + a retune recorder."""
+
+    max_batch = 128
+
+    def __init__(self, rows: dict | None = None,
+                 shed: dict | None = None):
+        self.rows = rows or {}
+        self.shed = shed or {}
+        self.retuned: list[OperatingPoint] = []
+
+    def stats(self):
+        return self.rows
+
+    def shed_totals(self):
+        return self.shed
+
+    def retune(self, op):
+        self.retuned.append(op)
+
+
+def _controller(hub=None, admission=None, **cfg_kw) -> TuneController:
+    cfg = TuneSettings(enabled=True, **cfg_kw)
+    state = TuneState(cfg)
+    return TuneController(hub or _FakeHub(), state, admission=admission)
+
+
+def _proposals(ctrl: TuneController, sig: dict,
+               op: OperatingPoint | None = None) -> dict:
+    return {k: (v, why)
+            for k, v, why in ctrl._propose(sig, op or OperatingPoint())}
+
+
+def _toy_engine(name: str, **kw) -> BatchEngine:
+    kwargs = dict(
+        step_fn=lambda params, x: x * 2.0 + 1.0,
+        params=None,
+        plan=None,
+        max_batch=4,
+        deadline_ms=4.0,
+        input_names=("x",),
+        stall_timeout_s=0,
+    )
+    kwargs.update(kw)
+    return BatchEngine(name, **kwargs)
+
+
+def _x(v: float) -> np.ndarray:
+    return np.full((2,), v, np.float32)
+
+
+def _fresh(monkeypatch, **env: str) -> None:
+    """Reset the memoized TuneState under a controlled env. The
+    autouse conftest fixture restores the memo on teardown; settings
+    are re-reset here so a flipped EVAM_TUNE never leaks."""
+    monkeypatch.delenv("EVAM_TUNE", raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    reset_settings()
+    control_state.reset_cache()
+
+
+@pytest.fixture(autouse=True)
+def _restore_settings():
+    yield
+    reset_settings()
+
+
+# ------------------------------------------------------- control laws
+
+
+class TestLaws:
+    def test_pressure_stretches_deadlines(self):
+        p = _proposals(_controller(), _sig(utilization=0.9))
+        assert p["deadline_scale"][0] == 1.25
+
+    def test_headroom_shrinks_deadlines(self):
+        p = _proposals(_controller(), _sig(utilization=0.2))
+        assert p["deadline_scale"][0] == 0.75
+
+    def test_dead_band_decays_toward_neutral(self):
+        ctrl = _controller()
+        p = _proposals(ctrl, _sig(utilization=0.65),
+                       OperatingPoint(deadline_scale=1.5))
+        assert p["deadline_scale"][0] == 1.25
+        # decay snaps AT neutral instead of oscillating across it
+        p = _proposals(ctrl, _sig(utilization=0.65),
+                       OperatingPoint(deadline_scale=1.25))
+        assert p["deadline_scale"][0] == 1.0
+        p = _proposals(ctrl, _sig(utilization=0.65))
+        assert "deadline_scale" not in p
+
+    def test_batch_cap_follows_demand_mix(self):
+        p = _proposals(_controller(), _sig(batch_p95=8.0))
+        assert p["batch_cap"][0] == 16  # p95 x2, under max_batch
+
+    def test_batch_cap_uncapped_on_queue_pressure(self):
+        p = _proposals(_controller(), _sig(queue_depth=200.0),
+                       OperatingPoint(batch_cap=16))
+        assert p["batch_cap"][0] == 0
+
+    def test_batch_cap_uncapped_when_demand_grows(self):
+        p = _proposals(_controller(), _sig(batch_p95=64.0),
+                       OperatingPoint(batch_cap=16))
+        assert p["batch_cap"][0] == 0
+
+    def test_transfer_deepens_when_launcher_waits(self):
+        p = _proposals(_controller(),
+                       _sig(h2d_wait_ms=2.0, launch_ms=4.0))
+        assert p["transfer_depth"][0] == 3  # static 2 + 1
+
+    def test_transfer_shallows_toward_static(self):
+        p = _proposals(_controller(),
+                       _sig(h2d_wait_ms=0.01, launch_ms=4.0),
+                       OperatingPoint(transfer_depth=5))
+        assert p["transfer_depth"][0] == 4
+
+    def test_transfer_never_below_static(self):
+        p = _proposals(_controller(),
+                       _sig(h2d_wait_ms=0.01, launch_ms=4.0))
+        assert "transfer_depth" not in p
+
+    def test_gate_tightens_under_pressure(self):
+        p = _proposals(_controller(), _sig(utilization=0.9))
+        assert p["gate_scale"][0] == 1.5
+
+    def test_gate_relaxes_only_to_configured(self):
+        p = _proposals(_controller(), _sig(utilization=0.2),
+                       OperatingPoint(gate_scale=1.5))
+        assert p["gate_scale"][0] == 1.0
+        p = _proposals(_controller(), _sig(utilization=0.2))
+        assert "gate_scale" not in p
+
+    def test_gate_relax_blocked_when_skips_would_reoverload(self):
+        # Utilization is low BECAUSE the gate is skipping; relaxing
+        # would re-admit that demand and oscillate. The relax law
+        # projects utilization with the skipped fps restored.
+        p = _proposals(_controller(),
+                       _sig(utilization=0.2, skip_fps=500.0,
+                            capacity_fps=300.0),
+                       OperatingPoint(gate_scale=3.0))
+        assert "gate_scale" not in p
+        # same headroom with few skips: relax proceeds
+        p = _proposals(_controller(),
+                       _sig(utilization=0.2, skip_fps=30.0,
+                            capacity_fps=300.0),
+                       OperatingPoint(gate_scale=3.0))
+        assert p["gate_scale"][0] == 2.5
+
+    def test_shed_pressure_lowers_admission_ceiling(self):
+        p = _proposals(_controller(), _sig(shed_delta=3.0))
+        # static admit_util (default 0.85) - 0.05
+        assert p["admit_util"][0] == pytest.approx(0.80)
+
+    def test_headroom_restores_admission_ceiling(self):
+        p = _proposals(_controller(), _sig(utilization=0.2),
+                       OperatingPoint(admit_util=0.70))
+        assert p["admit_util"][0] == pytest.approx(0.75)
+        # never above the static value
+        p = _proposals(_controller(), _sig(utilization=0.2),
+                       OperatingPoint(admit_util=0.84))
+        assert p["admit_util"][0] == pytest.approx(0.85)
+
+    def test_capacity_ewma(self):
+        p = _proposals(_controller(), _sig(capacity_fps=100.0),
+                       OperatingPoint(capacity_fps=200.0))
+        assert p["capacity_fps"][0] == pytest.approx(170.0)
+        # first reading seeds the EWMA
+        p = _proposals(_controller(), _sig(capacity_fps=100.0))
+        assert p["capacity_fps"][0] == pytest.approx(100.0)
+
+    def test_staleness_tightens_and_relaxes(self):
+        p = _proposals(_controller(),
+                       _sig(utilization=0.9, shed_delta=2.0))
+        assert p["staleness_scale"][0] == 0.75
+        p = _proposals(_controller(), _sig(utilization=0.2),
+                       OperatingPoint(staleness_scale=0.75))
+        assert p["staleness_scale"][0] == 1.0
+
+
+# ------------------------------------------- damping / cooldown / pins
+
+
+class TestDampingAndPins:
+    def test_action_needs_consecutive_agreeing_ticks(self):
+        ctrl = _controller(damping=3, cooldown=0)
+        ctrl.signals = lambda: _sig(utilization=0.9)
+        ctrl.tick()
+        ctrl.tick()
+        assert ctrl.state.op.deadline_scale == 1.0  # still damped
+        ctrl.tick()
+        assert ctrl.state.op.deadline_scale == 1.25
+
+    def test_direction_flip_resets_the_streak(self):
+        ctrl = _controller(damping=2, cooldown=0)
+        ctrl.signals = lambda: _sig(utilization=0.9)
+        ctrl.tick()
+        ctrl.signals = lambda: _sig(utilization=0.2)
+        ctrl.tick()  # direction flipped: streak restarts at 1
+        assert ctrl.state.op.deadline_scale == 1.0
+        ctrl.tick()
+        assert ctrl.state.op.deadline_scale == 0.75
+
+    def test_applied_knob_sits_out_the_cooldown(self):
+        ctrl = _controller(damping=1, cooldown=2)
+        ctrl.signals = lambda: _sig(utilization=0.9)
+        ctrl.tick()
+        assert ctrl.state.op.deadline_scale == 1.25
+        ctrl.tick()  # cooling
+        ctrl.tick()  # cooling
+        assert ctrl.state.op.deadline_scale == 1.25
+        ctrl.tick()
+        assert ctrl.state.op.deadline_scale == 1.5
+
+    def test_capacity_is_undamped(self):
+        ctrl = _controller(damping=3, cooldown=2)
+        ctrl.signals = lambda: _sig(capacity_fps=100.0)
+        ctrl.tick()
+        assert ctrl.state.op.capacity_fps == pytest.approx(100.0)
+
+    def test_actions_recorded_with_reasons(self):
+        ctrl = _controller(damping=1, cooldown=0)
+        ctrl.signals = lambda: _sig(utilization=0.9)
+        ctrl.tick()
+        actions = ctrl.state.snapshot()["actions"]
+        assert actions, "applied actions must land in the log"
+        assert {"tick", "knob", "from", "to", "reason"} <= set(actions[0])
+
+    def test_tick_pushes_the_op_to_the_hub(self):
+        hub = _FakeHub()
+        ctrl = _controller(hub=hub, damping=1, cooldown=0)
+        ctrl.signals = lambda: _sig(utilization=0.9)
+        ctrl.tick()
+        assert hub.retuned and hub.retuned[-1] is ctrl.state.op
+
+    def test_env_pinned_knob_is_clamped(self, monkeypatch):
+        monkeypatch.setenv("EVAM_TRANSFER_DEPTH", "3")
+        reset_settings()
+        ctrl = _controller(damping=1, cooldown=0)
+        assert ctrl.pins["transfer_depth"] is True
+        ctrl.signals = lambda: _sig(h2d_wait_ms=2.0, launch_ms=4.0)
+        ctrl.tick()
+        # the pinned knob never leaves neutral in the operating point
+        assert ctrl.state.op.transfer_depth == 0
+
+    def test_unpinned_by_default(self):
+        ctrl = _controller()
+        assert not any(ctrl.pins.values())
+
+
+# ------------------------------------------------ off-path guarantees
+
+
+class TestOffPath:
+    def test_off_resolves_to_none_and_memoizes(self, monkeypatch):
+        _fresh(monkeypatch)
+        assert control_state.active() is None
+        assert control_state.current_op() is None
+        # memoized: the resolve ran once, later consults are one load
+        assert control_state._resolved == (None,)
+
+    def test_on_returns_one_process_state(self, monkeypatch):
+        _fresh(monkeypatch, EVAM_TUNE="on")
+        st = control_state.active()
+        assert st is not None
+        assert control_state.active() is st
+        assert control_state.current_op() is st.op
+
+    def test_hub_level_identity_off_vs_neutral_on(self, monkeypatch):
+        """EVAM_TUNE=off must be byte-identical to the static path —
+        and a freshly-enabled controller (neutral operating point)
+        must not change a single output either."""
+        values = [float(i) for i in range(16)]
+
+        def run() -> list[np.ndarray]:
+            eng = _toy_engine("ctl-ab")
+            try:
+                futs = [eng.submit(x=_x(v)) for v in values]
+                return [f.result(timeout=10) for f in futs]
+            finally:
+                eng.stop()
+
+        _fresh(monkeypatch)  # off (default)
+        off = run()
+        _fresh(monkeypatch, EVAM_TUNE="on")  # on, neutral op
+        on = run()
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------- rebuild/setpoint inheritance
+
+
+class TestSetpointInheritance:
+    def test_engine_construction_reads_the_live_op(self, monkeypatch):
+        """A supervisor rebuild constructs a fresh BatchEngine from
+        the factory closure — it must resume at the controller's
+        CURRENT operating point, not the boot-time depth."""
+        _fresh(monkeypatch, EVAM_TUNE="on")
+        st = control_state.active()
+        st.install(OperatingPoint(transfer_depth=5), dict(ZERO_SIGNALS))
+        eng = _toy_engine("ctl-inherit")
+        try:
+            assert eng.transfer_depth == 5
+            assert eng._upload_q.maxsize == 5
+        finally:
+            eng.stop()
+        # the same factory args rebuild at the same live depth
+        rebuilt = _toy_engine("ctl-inherit-2")
+        try:
+            assert rebuilt.transfer_depth == 5
+        finally:
+            rebuilt.stop()
+
+    def test_off_uses_the_static_depth(self, monkeypatch):
+        _fresh(monkeypatch)
+        eng = _toy_engine("ctl-static", transfer_depth=4)
+        try:
+            assert eng.transfer_depth == 4
+        finally:
+            eng.stop()
+
+    def test_retune_resizes_the_upload_queue(self, monkeypatch):
+        _fresh(monkeypatch)
+        eng = _toy_engine("ctl-retune")
+        try:
+            assert eng.transfer_depth == 2
+            eng.retune(OperatingPoint(transfer_depth=4))
+            assert eng.transfer_depth == 4
+            assert eng._upload_q.maxsize == 4
+            # neutral op (0) leaves the current depth alone
+            eng.retune(OperatingPoint())
+            assert eng.transfer_depth == 4
+        finally:
+            eng.stop()
+
+    def test_tunable_queue_grow_wakes_blocked_putters(self):
+        q = _TunableQueue(maxsize=1)
+        q.put("a")
+        done = threading.Event()
+
+        def blocked_put():
+            q.put("b", timeout=5)
+            done.set()
+
+        t = threading.Thread(target=blocked_put, daemon=True)
+        t.start()
+        assert not done.wait(0.05), "put must block at the old bound"
+        q.set_depth(2)
+        assert done.wait(2), "growing the bound must wake the putter"
+        t.join(timeout=2)
+
+
+# ------------------------------------------------- /scheduler payload
+
+
+class TestSnapshotShape:
+    def test_disabled_snapshot_matches_live_shape(self):
+        st = TuneState(TuneSettings(enabled=True))
+        live = st.snapshot()
+        off = control_state.disabled_snapshot()
+        assert set(live) == set(off)
+        assert set(live["operating_point"]) == set(off["operating_point"])
+        assert set(live["signals"]) == set(off["signals"])
+        assert off["enabled"] is False and off["actions"] == []
+
+    def test_action_log_is_bounded(self):
+        st = TuneState(TuneSettings(enabled=True, actions=4))
+        for i in range(10):
+            st.record({"tick": i})
+        actions = st.snapshot()["actions"]
+        assert len(actions) == 4
+        assert actions[0]["tick"] == 6  # oldest evicted first
+
+    def test_signals_filtered_to_the_fixed_vocabulary(self):
+        st = TuneState(TuneSettings(enabled=True))
+        st.install(OperatingPoint(), {"utilization": 0.5, "junk": 1.0})
+        snap = st.snapshot()
+        assert set(snap["signals"]) == set(ZERO_SIGNALS)
+        assert snap["signals"]["utilization"] == 0.5
