@@ -1,6 +1,6 @@
 """Contract-drift pass.
 
-Three cross-file contracts that have only reviewer vigilance between
+Four cross-file contracts that have only reviewer vigilance between
 them and silent drift:
 
 1. **Metrics** — every ``evam_*`` metric name used anywhere must be
@@ -19,6 +19,12 @@ them and silent drift:
    ringbuf / the bench tools), so renaming a producer key without
    updating the pins — or vice versa — fails at lint time, not in CI's
    slowest job.
+4. **Checkpoint schema** — ``state/checkpoint.py`` persists
+   ``StreamCheckpoint`` across process restarts; its dataclass fields
+   must exactly match the pinned ``SCHEMA_V{SCHEMA_VERSION}_FIELDS``
+   tuple. Adding/removing/reordering a field without bumping
+   ``SCHEMA_VERSION`` (and pinning a new tuple) would silently change
+   the wire shape old blobs decode against — fail it at lint time.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ from .core import Finding, SourceFile
 METRICS_MODULE = "evam_tpu/obs/metrics.py"
 RINGBUF = "evam_tpu/engine/ringbuf.py"
 ADMISSION = "evam_tpu/sched/admission.py"
+CHECKPOINT = "evam_tpu/state/checkpoint.py"
 
 #: metrics.<method> → positional index of the labels argument
 _METRIC_METHODS = {
@@ -289,9 +296,79 @@ def _check_bench_keys(root: Path, findings: list[Finding]) -> None:
                 f"literal — renamed on one side only?"))
 
 
+# -------------------------------------------------------- ckpt schema
+
+def _int_constant(tree: ast.AST, name: str) -> int | None:
+    for node in ast.walk(tree):
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target] if isinstance(node, ast.AnnAssign) else []
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                return node.value.value
+    return None
+
+
+def _dataclass_fields(tree: ast.AST, cls: str) -> list[str] | None:
+    """Annotated field names of a dataclass, in declaration order —
+    exactly what dataclasses.fields() would report."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return [
+                st.target.id for st in node.body
+                if isinstance(st, ast.AnnAssign)
+                and isinstance(st.target, ast.Name)
+            ]
+    return None
+
+
+def _check_ckpt_schema(files: list[SourceFile],
+                       findings: list[Finding]) -> None:
+    """StreamCheckpoint persists across restarts: its fields must match
+    the pinned SCHEMA_V{N}_FIELDS tuple for the current SCHEMA_VERSION,
+    so any field change forces a deliberate version bump."""
+    sf = next((s for s in files if s.rel == CHECKPOINT), None)
+    if sf is None or sf.tree is None:
+        # no state/checkpoint.py (fixture repos, pre-EVAM_CKPT trees):
+        # nothing persists, so there is no wire schema to pin —
+        # deleting the module in THIS repo breaks imports loudly
+        return
+    version = _int_constant(sf.tree, "SCHEMA_VERSION")
+    if version is None:
+        findings.append(Finding(
+            "contracts", CHECKPOINT, 1, "ckpt-version-missing",
+            "state/checkpoint.py must define SCHEMA_VERSION as an int "
+            "literal"))
+        return
+    fields = _dataclass_fields(sf.tree, "StreamCheckpoint")
+    if not fields:
+        findings.append(Finding(
+            "contracts", CHECKPOINT, 1, "ckpt-fields-missing",
+            "state/checkpoint.py must define the StreamCheckpoint "
+            "dataclass with annotated fields"))
+        return
+    pinned = _tuple_of_strings(sf.tree, f"SCHEMA_V{version}_FIELDS")
+    if pinned is None:
+        findings.append(Finding(
+            "contracts", CHECKPOINT, 1, "ckpt-pin-missing",
+            f"SCHEMA_VERSION={version} has no pinned "
+            f"SCHEMA_V{version}_FIELDS tuple — every schema version "
+            f"pins its field tuple"))
+        return
+    if list(fields) != list(pinned):
+        findings.append(Finding(
+            "contracts", CHECKPOINT, 1, "ckpt-schema-drift",
+            f"StreamCheckpoint fields {tuple(fields)} != pinned "
+            f"SCHEMA_V{version}_FIELDS {tuple(pinned)} — a field "
+            f"change requires bumping SCHEMA_VERSION and pinning a "
+            f"new tuple (old blobs must decode against a known shape)"))
+
+
 def run(root: Path, files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     _check_metrics(root, files, findings)
     _check_stages(root, files, findings)
     _check_bench_keys(root, findings)
+    _check_ckpt_schema(files, findings)
     return findings

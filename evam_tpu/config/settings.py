@@ -163,6 +163,35 @@ class TraceSettings(BaseModel):
     flight_dir: str = ""
     #: most-recent records of each kind written per flight dump
     flight_n: int = 256
+    #: flight-recorder disk bound: keep at most this many
+    #: flight-*.jsonl files in flight_dir (oldest rotated out after
+    #: every dump; 0 = unbounded, the pre-cap behavior)
+    flight_max_files: int = 64
+    #: flight-recorder disk bound: total bytes across retained dumps
+    #: (oldest rotated out first; 0 = unbounded)
+    flight_max_bytes: int = 67108864
+
+
+class CkptSettings(BaseModel):
+    """Crash-consistent stream-state checkpoints (evam_tpu/state/):
+    a versioned, CRC-guarded StreamCheckpoint of every stream's
+    serving state (gate luma grid, coaster velocities, tracker
+    identities, sched class, trace continuity) captured at the
+    post-resolve and pre-rebalance barriers and restored before the
+    first frame after a migration, rebuild, or restart.
+    ``EVAM_CKPT=off`` (default until proven) disables the whole layer
+    — byte-identical A/B, same discipline as EVAM_TRANSFER /
+    EVAM_GATE / EVAM_TRACE."""
+
+    enabled: bool = False
+    #: post-resolve capture cadence: refresh a stream's checkpoint
+    #: every N resolved frames (1 = every frame; the barrier capture
+    #: is a dict build + CRC, no device work)
+    interval: int = 30
+    #: restore budget in seconds: a restore slower than this (stuck
+    #: state volume, injected restore_ms fault) is abandoned for a
+    #: loud cold start — a checkpoint must never wedge a stream
+    restore_timeout_s: float = 2.0
 
 
 class TuneSettings(BaseModel):
@@ -242,6 +271,7 @@ class Settings(BaseModel):
     sched: SchedSettings = Field(default_factory=SchedSettings)
     trace: TraceSettings = Field(default_factory=TraceSettings)
     tune: TuneSettings = Field(default_factory=TuneSettings)
+    ckpt: CkptSettings = Field(default_factory=CkptSettings)
 
     @classmethod
     def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
@@ -329,11 +359,24 @@ class Settings(BaseModel):
             "EVAM_TRACE_SLOW_MS": ("slow_ms", float),
             "EVAM_TRACE_FLIGHT_DIR": ("flight_dir", str),
             "EVAM_TRACE_FLIGHT_N": ("flight_n", int),
+            "EVAM_TRACE_FLIGHT_MAX_FILES": ("flight_max_files", int),
+            "EVAM_TRACE_FLIGHT_MAX_BYTES": ("flight_max_bytes", int),
         }
         if isinstance(trace, dict):
             for var, (key, conv) in trace_mapping.items():
                 if var in env:
                     trace[key] = conv(env[var])
+
+        ckpt = data.setdefault("ckpt", {})
+        ckpt_mapping = {
+            "EVAM_CKPT": ("enabled", _parse_bool),
+            "EVAM_CKPT_INTERVAL": ("interval", int),
+            "EVAM_CKPT_RESTORE_TIMEOUT_S": ("restore_timeout_s", float),
+        }
+        if isinstance(ckpt, dict):
+            for var, (key, conv) in ckpt_mapping.items():
+                if var in env:
+                    ckpt[key] = conv(env[var])
 
         tune = data.setdefault("tune", {})
         tune_mapping = {

@@ -300,6 +300,15 @@ class SupervisedEngine:
         # queue/in-flight state to a JSONL artifact BEFORE abandon()
         # fails the stranded futures and mutates the evidence
         trace.flight_dump(self.name, reason, state=_engine_snapshot(eng))
+        # crash-consistency barrier (evam_tpu/state/): snapshot every
+        # registered stream's cross-frame state before the swap — if
+        # this rebuild cascades into a process restart, the resumed
+        # streams restore from a checkpoint no older than the wedge
+        from evam_tpu.state import active as ckpt_active
+
+        ckpt = ckpt_active()
+        if ckpt is not None:
+            ckpt.capture_all(barrier="pre_rebuild")
         self._absorb_counters(eng)
         eng.abandon()
         while not self._stop_evt.is_set():

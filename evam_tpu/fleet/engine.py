@@ -38,7 +38,7 @@ import threading
 
 from evam_tpu.engine.batcher import EngineStats
 from evam_tpu.fleet.placer import ConsistentHashPlacer
-from evam_tpu.obs import get_logger, metrics
+from evam_tpu.obs import faults, get_logger, metrics
 
 log = get_logger("fleet.engine")
 
@@ -146,6 +146,21 @@ class FleetEngine:
                                        stream=stream, trace=trace,
                                        **inputs)
         label = self._place(stream or "")
+        # fault drill: current() is memoized (None-check when clean)
+        # and re-resolved per submit — soaks arm EVAM_FAULT_INJECT
+        # after the fleet is built and warm
+        inj = faults.current()
+        if inj is not None:
+            with self._lock:
+                survivors = len(self.shards) > 1
+            if survivors and inj.maybe_shard_loss(label):
+                # injected chip loss mid-dispatch: the placed shard
+                # dies between placement and submit — exactly the
+                # window the checkpoint/migration path must cover
+                # (never injected on the last live shard; a fleet of
+                # zero can't serve)
+                self._retire(label, reason="shard_loss")
+                label = self._place(stream or "")
         with self._lock:
             eng = self.shards.get(label)
         if eng is None:  # retired between place and lookup
@@ -176,9 +191,22 @@ class FleetEngine:
         for label in dead:
             self._retire(label)
 
-    def _retire(self, label: str) -> None:
+    def _retire(self, label: str, reason: str = "shard_loss") -> None:
         """Drain-and-rebalance one degraded shard: absorb counters,
         migrate its streams, fail its in-flight work via stop()."""
+        # checkpoint BEFORE the pins move: the pre-rebalance barrier
+        # snapshots each migrating stream's cross-frame state so the
+        # destination shard's first frame sees the same gate/coaster/
+        # tracker state the lost chip had (evam_tpu/state/). Capture
+        # takes the instance's own locks — must run outside _lock.
+        from evam_tpu.state import active as ckpt_active
+
+        store = ckpt_active()
+        if store is not None:
+            with self._lock:
+                doomed = [s for s, l in self._pins.items() if l == label]
+            for s in doomed:
+                store.capture(s, barrier="pre_rebalance", reason=reason)
         with self._lock:
             eng = self.shards.pop(label, None)
             if eng is None:
@@ -218,6 +246,23 @@ class FleetEngine:
         t.start()
         with self._lock:
             self._drains.append(t)
+
+    def scale_down(self, label: str | None = None) -> str | None:
+        """Deliberate fleet scale-down: retire one live shard (the
+        highest-numbered by default), migrating its streams with a
+        pre-rebalance checkpoint exactly like a chip loss — a planned
+        shrink must not cost tracker identities. Refuses to retire the
+        last shard. Returns the retired label (None = nothing done)."""
+        with self._lock:
+            live = sorted(self.shards)
+            if len(live) <= 1:
+                return None
+            if label is None:
+                label = live[-1]
+            elif label not in self.shards:
+                return None
+        self._retire(label, reason="scale_down")
+        return label
 
     @staticmethod
     def _safe_stop(eng) -> None:

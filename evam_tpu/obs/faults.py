@@ -24,6 +24,24 @@ Known keys (all probabilities are per-consult, 0..1):
                  unlimited); ``wedge=1,wedge_n=1`` wedges exactly the
                  first dispatched batch — the deterministic chaos-test
                  shape.
+* ``shard_loss``   — probability a fleet shard is retired mid-dispatch
+                 (evam_tpu/fleet/engine.py consults per submit): the
+                 chip-loss drill without waiting out a wedge→watchdog
+                 cycle. Streams migrate per the rebalance path.
+* ``shard_loss_n`` — maximum shard-loss events (default unlimited);
+                 ``shard_loss=1,shard_loss_n=1`` kills exactly the
+                 next dispatched-to shard — deterministic.
+* ``ckpt_corrupt`` — probability a captured StreamCheckpoint
+                 (evam_tpu/state/) is stored with a flipped CRC: the
+                 restore side must degrade to a LOUD cold start
+                 (evam_ckpt_restore_failures_total{reason="crc"}),
+                 never a wedge.
+* ``double_fault`` — probability a migration-barrier capture itself
+                 fails (the second failure during a migration): the
+                 stream cold-starts on the destination.
+* ``restore_ms``   — injected checkpoint-restore stall in ms; past
+                 EVAM_CKPT_RESTORE_TIMEOUT_S the restore is abandoned
+                 for a cold start (reason="timeout").
 
 ``EVAM_FAULT_SEED`` (integer) seeds the injector's RNG so chaos runs
 are reproducible; unset means a fresh nondeterministic seed per
@@ -59,7 +77,10 @@ ENV_KEYS: tuple[str, ...] = ("EVAM_FAULT_INJECT", "EVAM_FAULT_SEED")
 #: module docstring) — the single source for "keys: drop, stall, …"
 #: lists in deploy configs.
 SPEC_KEYS: tuple[str, ...] = ("drop", "stall", "stall_ms", "corrupt",
-                              "error", "wedge", "wedge_s", "wedge_n")
+                              "error", "wedge", "wedge_s", "wedge_n",
+                              "shard_loss", "shard_loss_n",
+                              "ckpt_corrupt", "double_fault",
+                              "restore_ms")
 
 _KNOWN_KEYS = set(SPEC_KEYS)
 
@@ -92,6 +113,12 @@ class FaultInjector:
         self.wedge_s = cfg.get("wedge_s", 30.0)
         #: remaining wedge events; < 0 means unlimited
         self._wedge_left = int(cfg.get("wedge_n", -1))
+        self.shard_loss_p = cfg.get("shard_loss", 0.0)
+        #: remaining shard-loss events; < 0 means unlimited
+        self._shard_loss_left = int(cfg.get("shard_loss_n", -1))
+        self.ckpt_corrupt_p = cfg.get("ckpt_corrupt", 0.0)
+        self.double_fault_p = cfg.get("double_fault", 0.0)
+        self.restore_ms = cfg.get("restore_ms", 0.0)
         self._rng = random.Random(seed)
         # one injector is shared by every stream thread AND every
         # engine dispatcher (from_env cache) — the wedge countdown
@@ -102,7 +129,9 @@ class FaultInjector:
     def active(self) -> bool:
         return any(
             p > 0 for p in (self.drop_p, self.stall_p, self.corrupt_p,
-                            self.error_p, self.wedge_p)
+                            self.error_p, self.wedge_p,
+                            self.shard_loss_p, self.ckpt_corrupt_p,
+                            self.double_fault_p, self.restore_ms)
         )
 
     def apply(self, frame: np.ndarray | None):
@@ -152,6 +181,63 @@ class FaultInjector:
         log.error("injected wedge: stalling engine %s for %.1fs "
                   "(EVAM_FAULT_INJECT)", name or "?", self.wedge_s)
         time.sleep(self.wedge_s)
+
+    def maybe_shard_loss(self, name: str = "") -> bool:
+        """Fleet-side consult (FleetEngine.submit, per dispatch): True
+        means "this shard just died" — the caller retires it and the
+        rebalance path migrates its streams. The deterministic shape
+        ``shard_loss=1,shard_loss_n=1`` kills exactly one shard."""
+        if not self.shard_loss_p:
+            return False
+        with self._lock:
+            if self._shard_loss_left == 0:
+                return False
+            if self._rng.random() >= self.shard_loss_p:
+                return False
+            if self._shard_loss_left > 0:
+                self._shard_loss_left -= 1
+        metrics.inc("evam_faults_injected",
+                    labels={"kind": "shard_loss"})
+        log.error("injected shard loss: retiring shard %s mid-dispatch "
+                  "(EVAM_FAULT_INJECT)", name or "?")
+        return True
+
+    def maybe_ckpt_corrupt(self) -> bool:
+        """Checkpoint-capture consult: True = store the blob with a
+        flipped CRC so the restore side must take the loud-cold-start
+        rung (never a wedge)."""
+        if not self.ckpt_corrupt_p:
+            return False
+        with self._lock:
+            hit = self._rng.random() < self.ckpt_corrupt_p
+        if hit:
+            metrics.inc("evam_faults_injected",
+                        labels={"kind": "ckpt_corrupt"})
+            log.error("injected checkpoint corruption "
+                      "(EVAM_FAULT_INJECT ckpt_corrupt)")
+        return hit
+
+    def maybe_double_fault(self) -> bool:
+        """Migration-capture consult: True = the capture itself fails
+        (the second failure during a migration) — the stream
+        cold-starts on the destination shard."""
+        if not self.double_fault_p:
+            return False
+        with self._lock:
+            hit = self._rng.random() < self.double_fault_p
+        if hit:
+            metrics.inc("evam_faults_injected",
+                        labels={"kind": "double_fault"})
+        return hit
+
+    def maybe_restore_stall(self) -> None:
+        """Checkpoint-restore consult: sleep ``restore_ms`` so the
+        restore-timeout degradation rung is drillable."""
+        if self.restore_ms <= 0:
+            return
+        metrics.inc("evam_faults_injected",
+                    labels={"kind": "restore_stall"})
+        time.sleep(self.restore_ms / 1e3)
 
 
 _cache: tuple[tuple[str, str], FaultInjector | None] | None = None

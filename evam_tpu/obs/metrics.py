@@ -66,6 +66,12 @@ METRIC_SPECS: dict[str, tuple[str, tuple[str, ...]]] = {
     "evam_eii_ingest_drops": ("counter", ()),
     # chaos / fault injection
     "evam_faults_injected": ("counter", ("kind",)),
+    # crash-consistent stream state (evam_tpu/state/): migrations by
+    # why the stream moved (shard_loss/engine_rebuild/scale_down/
+    # drain/stale_refresh) and restore failures by degradation rung
+    # (crc/version/timeout/apply/capture/double_fault)
+    "evam_stream_migrations": ("counter", ("reason",)),
+    "evam_ckpt_restore_failures": ("counter", ("reason",)),
     # per-frame tracing (obs/trace.py): tail-sampling retention split
     # by why a frame was kept (error/shed/deadline_miss/slow/sampled)
     # vs dropped, plus flight-recorder artifacts written per engine

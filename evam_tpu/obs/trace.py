@@ -163,13 +163,20 @@ class TraceRing:
 
     def __init__(self, enabled: bool = True, sample_n: int = 16,
                  ring: int = 1024, slow_ms: float = 250.0,
-                 flight_dir: str = "", flight_n: int = 256) -> None:
+                 flight_dir: str = "", flight_n: int = 256,
+                 flight_max_files: int = 64,
+                 flight_max_bytes: int = 64 * 1024 * 1024) -> None:
         self.enabled = enabled
         self.sample_n = max(1, int(sample_n))
         self.ring = max(1, int(ring))
         self.slow_ms = float(slow_ms)
         self.flight_dir = flight_dir
         self.flight_n = max(1, int(flight_n))
+        #: flight-recorder disk bound: a flapping engine quarantining
+        #: in a loop must not fill the artifact volume. Oldest-first
+        #: rotation after every dump; 0 = unbounded (either axis).
+        self.flight_max_files = int(flight_max_files)
+        self.flight_max_bytes = int(flight_max_bytes)
         self._lock = threading.Lock()
         self._frames: deque = deque(maxlen=self.ring)
         self._batches: deque = deque(maxlen=self.ring)
@@ -325,6 +332,8 @@ def active() -> TraceRing | None:
             enabled=cfg.enabled, sample_n=cfg.sample_n, ring=cfg.ring,
             slow_ms=cfg.slow_ms, flight_dir=cfg.flight_dir,
             flight_n=cfg.flight_n,
+            flight_max_files=cfg.flight_max_files,
+            flight_max_bytes=cfg.flight_max_bytes,
         ) if cfg.enabled else None
         _resolved = (ring,)
     return _resolved[0]
@@ -503,9 +512,56 @@ def flight_dump(engine: str, reason: str,
     except OSError as exc:
         log.warning("flight recorder dump failed: %s", exc)
         return None
+    _prune_flight_dir(out_dir, path, ring.flight_max_files,
+                      ring.flight_max_bytes)
     metrics.inc("evam_flight_dumps", labels={"engine": engine})
     log.error("flight recorder: engine %s (%s) -> %s", engine, reason, path)
     return path
+
+
+def _prune_flight_dir(out_dir: str, keep_path: str,
+                      max_files: int, max_bytes: int) -> None:
+    """Oldest-first rotation of flight-*.jsonl artifacts: an engine
+    flapping through quarantines (or a chaos soak) must not grow the
+    artifact volume without bound. The just-written dump is never
+    pruned — the freshest post-mortem always survives. 0 disables the
+    corresponding axis (EVAM_TRACE_FLIGHT_MAX_FILES / _MAX_BYTES)."""
+    if max_files <= 0 and max_bytes <= 0:
+        return
+    try:
+        entries = []
+        for fn in os.listdir(out_dir):
+            if not (fn.startswith("flight-") and fn.endswith(".jsonl")):
+                continue
+            p = os.path.join(out_dir, fn)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue  # concurrent prune/collection
+            entries.append((st.st_mtime, st.st_size, p))
+    except OSError as exc:
+        log.warning("flight recorder rotation scan failed: %s", exc)
+        return
+    entries.sort()
+    count = len(entries)
+    total = sum(size for _, size, _ in entries)
+    removed = 0
+    for _, size, p in entries:
+        if not ((max_files > 0 and count > max_files)
+                or (max_bytes > 0 and total > max_bytes)):
+            break
+        if os.path.abspath(p) == os.path.abspath(keep_path):
+            continue
+        try:
+            os.remove(p)
+        except OSError:
+            continue
+        count -= 1
+        total -= size
+        removed += 1
+    if removed:
+        log.info("flight recorder rotated out %d artifact(s) from %s",
+                 removed, out_dir)
 
 
 # -- profiler glue ------------------------------------------------------

@@ -40,6 +40,16 @@ DEFAULT_PRIORITY = "standard"
 STARVATION_LIMITS = {"standard": 4, "batch": 12}
 
 
+def coerce_priority(value: Any, default: str = DEFAULT_PRIORITY) -> str:
+    """Best-effort priority normalization for restored state
+    (evam_tpu/state checkpoints): a sched class decoded from a
+    possibly stale or corrupted checkpoint must never raise — an
+    unknown value falls back to ``default`` instead."""
+    if isinstance(value, str) and value.strip().lower() in PRIORITIES:
+        return value.strip().lower()
+    return default
+
+
 def validate_priority(value: Any) -> str:
     """Normalize + validate a request/spec ``priority`` value."""
     if not isinstance(value, str):

@@ -134,7 +134,17 @@ def build_app(
             await asyncio.to_thread(registry.hub.registry.describe))
 
     async def engines(request: web.Request) -> web.Response:
-        return web.json_response(registry.hub.stats())
+        payload = registry.hub.stats()
+        # crash-consistent stream state (evam_tpu/state/, EVAM_CKPT):
+        # capture/restore/migration counters next to the engine rows.
+        # Key can't collide — engine keys always contain ':'. Absent
+        # when off, so the legacy payload is byte-identical.
+        from evam_tpu.state import active as ckpt_active
+
+        store = ckpt_active()
+        if store is not None:
+            payload["checkpoint"] = store.summary()
+        return web.json_response(payload)
 
     async def scheduler(request: web.Request) -> web.Response:
         # QoS layer introspection (evam_tpu/sched/): capacity model,

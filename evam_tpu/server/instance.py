@@ -114,6 +114,9 @@ class StreamInstance:
         self._stop = threading.Event()
         # Guards _source against the stop()-vs-retry-reassignment race.
         self._src_lock = threading.Lock()
+        #: set by restore_checkpoint: where this instance's serving
+        #: state came from (rides the status payload when ckpt is on)
+        self._restored_from: dict[str, Any] | None = None
 
     # ------------------------------------------------------- lifecycle
 
@@ -320,6 +323,66 @@ class StreamInstance:
                     log.warning("stage %s state restore failed: %s",
                                 stage.name, exc)
 
+    # ------------------------------------- crash-consistent checkpoints
+
+    def _gate(self):
+        """The first gating stage's MotionGate, or None (at most one
+        detect-class stage gates per chain)."""
+        for stage in self.stages:
+            gate = getattr(stage, "gate", None)
+            if gate is not None:
+                return gate
+        return None
+
+    def checkpoint_payload(self) -> dict[str, Any] | None:
+        """StreamCheckpoint field values (evam_tpu/state/) minus the
+        envelope's own stream_id/captured_at/barrier — the capture
+        side of the crash-consistency contract. Called from capture
+        barriers on stream/fleet/supervisor threads; everything read
+        here is either immutable or tolerates a torn read (the
+        checkpoint is a snapshot, not a transaction)."""
+        runner = self._runner
+        gate = self._gate()
+        return {
+            "sched_class": self.priority,
+            "trace_marker": runner.last_trace_id if runner else "",
+            "frame_seq": runner.frames_out if runner else 0,
+            "max_skip": gate.cfg.max_skip if gate is not None else 0,
+            "skips_at_capture": (gate.consecutive_skips
+                                 if gate is not None else 0),
+            "fps": round(self.avg_fps, 3) or 30.0,
+            "stages": self.stage_state(),
+        }
+
+    def restore_checkpoint(self, ck, stale: bool = False) -> None:
+        """Apply a decoded StreamCheckpoint BEFORE start(). ``stale``
+        (older than the gate's max-skip bound) keeps only what never
+        goes stale — tracker id monotonicity — and forces the gate to
+        refresh; detections and the gate anchor are dropped so
+        correctness never depends on restore."""
+        from evam_tpu.sched.classes import coerce_priority
+
+        self.priority = coerce_priority(ck.sched_class, self.priority)
+        state = ck.stages
+        if stale:
+            pruned: dict[str, dict] = {}
+            for name, st in state.items():
+                if not isinstance(st, dict):
+                    continue
+                if "next_id" in st:
+                    pruned[name] = {"next_id": st["next_id"]}
+                elif "count" in st or "coaster" in st or "gate" in st:
+                    pruned[name] = {"count": st.get("count", 0),
+                                    "stale": True}
+            state = pruned
+        self.restore_stage_state(state)
+        self._restored_from = {
+            "barrier": ck.barrier,
+            "frame_seq": ck.frame_seq,
+            "trace_marker": ck.trace_marker,
+            "stale": stale,
+        }
+
     def status(self) -> dict[str, Any]:
         """Reference status payload shape: id, state, avg_fps,
         start_time, elapsed_time (+ error message when failed)."""
@@ -349,6 +412,20 @@ class StreamInstance:
         }
         if gates:
             out["gate"] = gates
+        # crash-consistent checkpoint block (evam_tpu/state/): present
+        # only when EVAM_CKPT=on — the off path keeps the
+        # reference-shaped payload byte-for-byte, like the gate block
+        from evam_tpu.state import active as ckpt_active
+
+        store = ckpt_active()
+        if store is not None:
+            ck: dict[str, Any] = {"held": False}
+            info = store.stream_info(self.id)
+            if info is not None:
+                ck.update(info)
+            if self._restored_from is not None:
+                ck["restored_from"] = self._restored_from
+            out["checkpoint"] = ck
         return out
 
     def _weight_provenance(self) -> dict[str, Any]:

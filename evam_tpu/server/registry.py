@@ -32,6 +32,8 @@ from evam_tpu.sched import (
 from evam_tpu.sched.classes import DEFAULT_PRIORITY
 from evam_tpu.server.instance import InstanceState, StreamInstance
 from evam_tpu.stages.build import build_stages
+from evam_tpu.state import active as ckpt_active
+from evam_tpu.state import is_checkpoint_blob
 
 log = get_logger("server.registry")
 
@@ -128,6 +130,10 @@ class PipelineRegistry:
         self.instances: dict[str, StreamInstance] = {}
         self._lock = threading.Lock()
         self._draining = False
+        #: crash-consistent checkpoint store (evam_tpu/state/,
+        #: EVAM_CKPT): resolved once, None when off — every hook below
+        #: is a single None-check on the legacy path
+        self._ckpt = ckpt_active()
         #: Optional RtspServer for destination.frame re-streaming
         #: (set by run_server when ENABLE_RTSP, reference
         #: docker-compose.yml:49-50).
@@ -364,7 +370,18 @@ class PipelineRegistry:
         if saved_state:
             # BEFORE start(): the first resumed frame must already see
             # the restored cross-frame state (tracker id high-water)
-            instance.restore_stage_state(saved_state)
+            if self._ckpt is not None and is_checkpoint_blob(saved_state):
+                # versioned+CRC-guarded StreamCheckpoint from a prior
+                # run's drain/migration barrier: full restore with the
+                # degradation ladder (corrupt/stale/timeout → loud
+                # cold start, never a failed start)
+                self._ckpt.restore_into(saved_state, instance)
+            else:
+                instance.restore_stage_state(saved_state)
+        if self._ckpt is not None:
+            # register before start(): the runner's first post-resolve
+            # capture must find the instance
+            self._ckpt.register(instance.id, instance)
         with self._lock:
             self.instances[instance.id] = instance
         instance.start()
@@ -387,6 +404,10 @@ class PipelineRegistry:
         inst = self.instances.get(instance_id)
         if inst is not None:
             inst.deleted = True  # deliberate: survives the drain filter
+            if self._ckpt is not None:
+                # a deliberate DELETE must not leave a checkpoint that
+                # could resurrect the stream on the next boot
+                self._ckpt.unregister(instance_id)
             inst.stop()
             self._persist()
         return inst
@@ -454,11 +475,31 @@ class PipelineRegistry:
                 # wait() timed out: this worker may still assign ids
                 # after the snapshot below — warn, the persisted state
                 # is best-effort for a wedged stream
+                if (self._ckpt is not None
+                        and self._ckpt.capture(
+                            inst.id, barrier="drain",
+                            reason="drain") is not None):
+                    # checkpointed instead of leaked: the straggler's
+                    # state is banked for the next boot's resume(), so
+                    # it is a migration, not a loss
+                    log.warning(
+                        "stream %s still draining at shutdown; "
+                        "checkpointed for resume", inst.id[:8],
+                    )
+                    continue
                 leaked += 1
                 log.warning(
                     "stream %s still draining at shutdown; persisted "
                     "state may lag", inst.id[:8],
                 )
+        if self._ckpt is not None:
+            # drain barrier for the cleanly-stopped streams: their
+            # workers are quiesced, so this capture is exactly the
+            # post-resolve state of their last frame — fresher than
+            # the periodic in-flight checkpoint
+            for inst in active:
+                if inst._thread is None or not inst._thread.is_alive():
+                    self._ckpt.capture(inst.id, barrier="drain")
         metrics.set("evam_shutdown_leaked_streams", leaked)
         if leaked:
             log.error(
@@ -496,17 +537,26 @@ class PipelineRegistry:
         ]
         self._write_state(active)
 
-    @staticmethod
-    def _entry(inst: StreamInstance) -> dict:
+    def _entry(self, inst: StreamInstance) -> dict:
         """One streams.json record (single definition — the drain and
         event persists must stay schema-identical)."""
+        state: dict = inst.stage_state()
+        if self._ckpt is not None:
+            # prefer the barrier-consistent StreamCheckpoint blob over
+            # the live read: the blob was taken with no frame mid-
+            # chain, carries the sched class / trace marker / staleness
+            # bound, and is CRC-guarded against torn writes. resume()
+            # feeds it back through restore_into's degradation ladder.
+            blob = self._ckpt.export(inst.id)
+            if blob is not None:
+                state = blob
         return {
             "pipeline": inst.pipeline_name,
             "version": inst.version,
             "request": inst.request,
             # cross-frame stage state (tracker id high-water mark
             # etc.) so a resumed stream keeps its invariants
-            "state": inst.stage_state(),
+            "state": state,
         }
 
     @staticmethod

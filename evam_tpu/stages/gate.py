@@ -250,6 +250,43 @@ class MotionGate:
             self._skip_times.popleft()
         return len(self._skip_times) / RATE_WINDOW_S
 
+    def state_dict(self) -> dict:
+        """Serializable controller state for a StreamCheckpoint
+        (evam_tpu/state/): the luma reference anchor, the hysteresis
+        phase and the skip counters — everything a migrated stream
+        needs to keep gating mid-scene instead of re-learning."""
+        return {
+            "ref_grid": (self._ref_grid.tolist()
+                         if self._ref_grid is not None else None),
+            "moving": bool(self._moving),
+            "consecutive_skips": int(self.consecutive_skips),
+            "since_run": int(self._since_run),
+            "last_score": float(self.last_score),
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Re-apply a ``state_dict()`` on a freshly built gate. A
+        shape-mismatched grid is dropped (the first frame then infers
+        unconditionally — the cold-start rung, never an error)."""
+        grid = state.get("ref_grid")
+        if grid is not None:
+            arr = np.asarray(grid, dtype=np.uint8)
+            if arr.shape == (GRID_H, GRID_W):
+                self._ref_grid = arr
+        self._moving = bool(state.get("moving", True))
+        self.consecutive_skips = int(state.get("consecutive_skips", 0))
+        self._since_run = int(state.get("since_run", 0))
+        self.last_score = float(state.get("last_score", 0.0))
+
+    def force_refresh(self) -> None:
+        """Stale-checkpoint rung: drop the reference anchor so the
+        next frame re-infers unconditionally (a forced refresh — the
+        gate's staleness bound never depends on restored state)."""
+        self._ref_grid = None
+        self._moving = True
+        self.consecutive_skips = 0
+        self._since_run = 0
+
     def snapshot(self) -> dict:
         """Per-stream gate state for /pipelines/.../{id}/status."""
         total = self.ran + self.skipped

@@ -81,6 +81,42 @@ def _timed_gate_decide(gate, ctx: FrameContext) -> bool:
     return go
 
 
+def _detect_state_snapshot(stage) -> dict | None:
+    """Shared ckpt-gated ``Stage.snapshot()`` body for the two
+    detect-class stages (DetectStage / FusedDetectClassifyStage):
+    gate controller state + coaster regions/velocities + the interval
+    counter. Returns None when EVAM_CKPT is off — base-class behavior,
+    byte-identical serve path."""
+    from evam_tpu import state as stream_state
+
+    if stream_state.active() is None:
+        return None
+    out: dict = {
+        "count": int(stage._count),
+        "coaster": stage._coaster.state_dict(),
+    }
+    if stage.gate is not None:
+        out["gate"] = stage.gate.state_dict()
+    return out
+
+
+def _detect_state_restore(stage, state: dict) -> None:
+    """Re-apply a ``_detect_state_snapshot`` on a freshly built stage.
+    A ``stale`` marker (checkpoint older than the gate's max-skip
+    bound — StreamInstance.restore_checkpoint prunes it to this) drops
+    the detections/gate anchor and forces a refresh — identities in
+    the track stage survive regardless."""
+    stage._count = int(state.get("count", 0))
+    if state.get("stale"):
+        if stage.gate is not None:
+            stage.gate.force_refresh()
+        return
+    if state.get("coaster"):
+        stage._coaster.load_state(state["coaster"])
+    if stage.gate is not None and state.get("gate"):
+        stage.gate.load_state(state["gate"])
+
+
 def _parse_interval(properties: dict) -> int:
     """``inference-interval``: a positive int, or ``"adaptive"`` —
     the motion gate replaces the static schedule (stages/gate.py), so
@@ -229,6 +265,12 @@ class DetectStage(AsyncStage):
         self._coaster.observe(regions)
         ctx.regions.extend(regions)
         return [ctx]
+
+    def snapshot(self) -> dict | None:
+        return _detect_state_snapshot(self)
+
+    def restore(self, state: dict) -> None:
+        _detect_state_restore(self, state)
 
 
 class ClassifyStage(AsyncStage):
@@ -613,3 +655,9 @@ class FusedDetectClassifyStage(AsyncStage):
         self._coaster.observe(regions)
         ctx.regions.extend(regions)
         return [ctx]
+
+    def snapshot(self) -> dict | None:
+        return _detect_state_snapshot(self)
+
+    def restore(self, state: dict) -> None:
+        _detect_state_restore(self, state)

@@ -27,6 +27,7 @@ from evam_tpu.obs.trace import observe_frame_latency, stage_timer
 from evam_tpu.sched.shedder import ShedError
 from evam_tpu.stages.base import AsyncStage, Stage
 from evam_tpu.stages.context import FrameContext
+from evam_tpu.state import active as ckpt_active
 
 log = get_logger("stages.runner")
 
@@ -61,6 +62,13 @@ class StreamRunner:
         self._parked: deque[_Parked] = deque()
         self._stopped = False
         self._faults = faults_from_env()
+        #: crash-consistent checkpoints (evam_tpu/state/): resolved
+        #: once at construction like the fault injector — None when
+        #: EVAM_CKPT=off, so the post-resolve hook is one None-check
+        self._ckpt = ckpt_active()
+        #: trace-id of the last resolved frame — the checkpoint's
+        #: trace-continuity marker (only maintained when ckpt is on)
+        self.last_trace_id = ""
 
     # ----------------------------------------------------------- API
 
@@ -190,6 +198,15 @@ class StreamRunner:
                 priority=ctx.priority,
                 trace_id=ctx.trace.trace_id if ctx.trace is not None else None)
         trace.finish_frame(ctx.trace, "ok")
+        if self._ckpt is not None:
+            # post-resolve barrier: the frame fully left the chain, so
+            # every stage's cross-frame state is consistent — refresh
+            # this stream's checkpoint on the capture cadence
+            if ctx.trace is not None:
+                self.last_trace_id = ctx.trace.trace_id
+            if self.frames_out % self._ckpt.interval == 0:
+                self._ckpt.capture(self.stream_id,
+                                   barrier="post_resolve")
 
     def _handle_error(self, exc: Exception, ctx: FrameContext) -> None:
         self.errors += 1

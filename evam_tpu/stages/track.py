@@ -129,6 +129,45 @@ class IouTracker:
                 survivors.append(track)
         self.tracks = survivors
 
+    def state_dict(self) -> dict:
+        """Serializable tracker state for a StreamCheckpoint: live
+        tracks (boxes, velocities, ages) plus the id counter, so a
+        migrated stream re-associates immediately with the SAME
+        object ids instead of reissuing."""
+        return {
+            "next_id": int(self._next_id),
+            "tracks": [
+                {
+                    "track_id": int(t.track_id),
+                    "box": [float(v) for v in t.box],
+                    "label_id": int(t.label_id),
+                    "age": int(t.age),
+                    "hits": int(t.hits),
+                    "vel": [float(v) for v in t.vel],
+                }
+                for t in self.tracks
+            ],
+        }
+
+    def load_state(self, state: dict) -> None:
+        self._next_id = max(
+            int(state.get("next_id", 1)), self._next_id)
+        tracks = []
+        for row in state.get("tracks", []):
+            try:
+                tracks.append(_Track(
+                    track_id=int(row["track_id"]),
+                    box=np.asarray(row["box"], np.float32),
+                    label_id=int(row["label_id"]),
+                    age=int(row.get("age", 0)),
+                    hits=int(row.get("hits", 1)),
+                    vel=np.asarray(
+                        row.get("vel", [0, 0, 0, 0]), np.float32),
+                ))
+            except (KeyError, TypeError, ValueError):
+                continue  # a malformed track row is dropped, not fatal
+        self.tracks = tracks
+
 
 class RegionCoaster:
     """Copy-on-write reuse + constant-velocity coasting of the last
@@ -213,6 +252,48 @@ class RegionCoaster:
             for r, v in zip(self._regions, self._vels)
         ]
 
+    def state_dict(self) -> dict:
+        """Serializable coaster state for a StreamCheckpoint: the
+        last detections' geometry/identity plus per-region velocity.
+        Classifier Tensor payloads are NOT carried — a restored
+        coast serves boxes+ids until the next real inference refills
+        attributes (the same contract as a gate skip after restart)."""
+        return {
+            "regions": [
+                {
+                    "box": [r.x0, r.y0, r.x1, r.y1],
+                    "confidence": float(r.confidence),
+                    "label_id": int(r.label_id),
+                    "label": r.label,
+                    "object_id": r.object_id,
+                }
+                for r in self._regions
+            ],
+            "vels": [[float(v) for v in vel] for vel in self._vels],
+        }
+
+    def load_state(self, state: dict) -> None:
+        regions, vels = [], []
+        rows = state.get("regions", [])
+        raw_vels = state.get("vels", [])
+        for i, row in enumerate(rows):
+            try:
+                box = row["box"]
+                regions.append(Region(
+                    x0=float(box[0]), y0=float(box[1]),
+                    x1=float(box[2]), y1=float(box[3]),
+                    confidence=float(row.get("confidence", 0.0)),
+                    label_id=int(row.get("label_id", 0)),
+                    label=str(row.get("label", "")),
+                    object_id=row.get("object_id"),
+                ))
+            except (KeyError, TypeError, ValueError, IndexError):
+                continue
+            vel = (raw_vels[i] if i < len(raw_vels) else [0, 0, 0, 0])
+            vels.append(np.asarray(vel, np.float32))
+        self._regions = regions
+        self._vels = vels
+
 
 class TrackStage(Stage):
     #: tracking-type → (coasting frames override, motion extrapolation)
@@ -251,8 +332,18 @@ class TrackStage(Stage):
         # id monotonicity is the cross-restart invariant consumers
         # depend on (object_id in published metadata, reference
         # evas/publisher.py:210); track boxes themselves re-associate
-        # within a few frames and are not worth serializing
+        # within a few frames and are not worth serializing — UNLESS
+        # checkpointing is on (EVAM_CKPT, evam_tpu/state/): a live
+        # migration resumes mid-scene, where the full track set is
+        # what preserves identities across the move
+        from evam_tpu import state as stream_state
+
+        if stream_state.active() is not None:
+            return {"next_id": self.tracker._next_id,
+                    "tracker": self.tracker.state_dict()}
         return {"next_id": self.tracker._next_id}
 
     def restore(self, state: dict) -> None:
         self.tracker._next_id = int(state.get("next_id", 1))
+        if state.get("tracker"):
+            self.tracker.load_state(state["tracker"])

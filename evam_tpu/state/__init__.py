@@ -1,0 +1,26 @@
+"""Crash-consistent stream serving state (EVAM_CKPT).
+
+``StreamCheckpoint`` is a versioned, CRC-guarded snapshot of the
+per-stream serving state the rest of the stack otherwise loses on a
+migration, rebuild or restart — the MotionGate luma grid and
+hysteresis phase, RegionCoaster velocities and last detections,
+tracker identities, the sched class, and a trace-continuity marker.
+``CheckpointStore`` captures it at well-defined barriers
+(post-resolve, pre-rebalance) and restores it before the first frame
+on the destination shard. ``EVAM_CKPT=off`` (the default) keeps every
+hook a memoized None-check — byte-identical A/B.
+"""
+
+from evam_tpu.state.checkpoint import (  # noqa: F401
+    SCHEMA_VERSION,
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointStore,
+    CheckpointVersionError,
+    StreamCheckpoint,
+    active,
+    decode,
+    encode,
+    is_checkpoint_blob,
+    reset_cache,
+)

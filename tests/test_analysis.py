@@ -381,6 +381,78 @@ def test_contracts_bench_pin_without_producer(tmp_path):
         ("bench-key:renamed_key", "tests/test_bench_contract.py")]
 
 
+_CKPT_OK = """
+    SCHEMA_VERSION = 1
+    SCHEMA_V1_FIELDS = ("stream_id", "stages")
+
+    class StreamCheckpoint:
+        stream_id: str
+        stages: dict
+"""
+
+
+def test_contracts_ckpt_schema_pinned_is_clean(tmp_path):
+    files = contract_tree(
+        tmp_path,
+        **{"evam_tpu/user.py": """
+            from evam_tpu.obs.metrics import metrics
+            metrics.inc("evam_things", labels={"engine": "a"})
+        """,
+           "evam_tpu/state/checkpoint.py": _CKPT_OK})
+    assert contracts.run(tmp_path, files) == []
+
+
+def test_contracts_ckpt_field_change_without_bump_is_drift(tmp_path):
+    files = contract_tree(
+        tmp_path,
+        **{"evam_tpu/user.py": """
+            from evam_tpu.obs.metrics import metrics
+            metrics.inc("evam_things", labels={"engine": "a"})
+        """,
+           "evam_tpu/state/checkpoint.py": """
+            SCHEMA_VERSION = 1
+            SCHEMA_V1_FIELDS = ("stream_id", "stages")
+
+            class StreamCheckpoint:
+                stream_id: str
+                frame_seq: int
+                stages: dict
+        """})
+    idents = {f.ident for f in contracts.run(tmp_path, files)}
+    assert idents == {"ckpt-schema-drift"}
+
+
+def test_contracts_ckpt_bump_without_new_pin_flagged(tmp_path):
+    files = contract_tree(
+        tmp_path,
+        **{"evam_tpu/user.py": """
+            from evam_tpu.obs.metrics import metrics
+            metrics.inc("evam_things", labels={"engine": "a"})
+        """,
+           "evam_tpu/state/checkpoint.py": """
+            SCHEMA_VERSION = 2
+            SCHEMA_V1_FIELDS = ("stream_id", "stages")
+
+            class StreamCheckpoint:
+                stream_id: str
+                stages: dict
+        """})
+    idents = {f.ident for f in contracts.run(tmp_path, files)}
+    assert idents == {"ckpt-pin-missing"}
+
+
+def test_contracts_repo_checkpoint_matches_live_dataclass(tmp_path):
+    """The AST field walk must agree with dataclasses.fields() on the
+    real module — the pin is only as strong as that equivalence."""
+    import dataclasses
+
+    from evam_tpu.state import checkpoint as ck_mod
+
+    live = [f.name for f in dataclasses.fields(ck_mod.StreamCheckpoint)]
+    assert tuple(live) == ck_mod.SCHEMA_V1_FIELDS
+    assert ck_mod.SCHEMA_VERSION == 1
+
+
 # ---------------------------------------------------------------- imports
 
 def test_imports_cycle_detected(tmp_path):

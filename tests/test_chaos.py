@@ -49,3 +49,33 @@ def test_chaos_soak_recovers_within_budget(eight_devices, monkeypatch):
     assert not result["degraded_engines"], result
     assert result["frames_out"] > 0, result
     assert result["errors"] > 0, result  # the faults really fired
+
+
+@pytest.mark.chaos
+def test_chaos_shard_loss_during_migration(eight_devices, monkeypatch):
+    """Crash-consistent state PR: two consecutive injected chip losses
+    on a sharded fleet with EVAM_CKPT=on — the second fires while the
+    first loss's streams are migrating. Zero realtime failures, no
+    frame resolved twice, every move counted (and checkpointed) on
+    evam_stream_migrations_total{reason="shard_loss"}."""
+    from chaos_soak import run_shard_loss_soak
+
+    # run_shard_loss_soak owns (and restores) the fault/ckpt env;
+    # monkeypatch scopes the mutations to this test regardless
+    monkeypatch.setenv("EVAM_FAULT_INJECT", "")
+    monkeypatch.setenv("EVAM_CKPT", "on")
+    result = run_shard_loss_soak(
+        streams=3,
+        frames=150,  # 5 s realtime @30fps — spans both losses
+        shards=3,
+        losses=2,
+        seed=11,
+        timeout_s=120.0,
+    )
+    assert result["ok"], result
+    assert result["shard_losses_injected"] == 2, result
+    assert result["migrations"] >= 1, result
+    assert not result["duplicate_streams"], result
+    assert not [s for s in result["states"] if s != "COMPLETED"], result
+    # the pre-rebalance barrier banked state for the moved streams
+    assert result["checkpoint"].get("captured", 0) >= 1, result

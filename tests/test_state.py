@@ -1,0 +1,364 @@
+"""Unit contracts for crash-consistent stream state
+(evam_tpu/state/checkpoint.py): encode/decode round-trip, CRC and
+schema-version guards, the staleness math against the gate's max-skip
+bound, the CheckpointStore capture/restore plane with its full
+degradation ladder (corrupt → cold start, version → cold start,
+injected restore stall → timeout cold start, apply failure → cold
+start, stale → identities-only + forced refresh), the fault-matrix
+hooks (ckpt_corrupt, double_fault, restore_ms), and the EVAM_CKPT=off
+memoized-None knob discipline."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from evam_tpu.config.settings import reset_settings
+from evam_tpu.obs import faults
+from evam_tpu.obs.metrics import metrics
+from evam_tpu.state import (
+    SCHEMA_VERSION,
+    CheckpointCorrupt,
+    CheckpointStore,
+    CheckpointVersionError,
+    StreamCheckpoint,
+    active,
+    decode,
+    encode,
+    is_checkpoint_blob,
+    reset_cache,
+)
+
+
+def _ck(**kw) -> StreamCheckpoint:
+    base = dict(
+        stream_id="cam0",
+        sched_class="realtime",
+        trace_marker="tid-42",
+        frame_seq=17,
+        captured_at=time.time(),
+        barrier="post_resolve",
+        max_skip=8,
+        skips_at_capture=2,
+        fps=30.0,
+        stages={"gate": {"skips": 2}, "track": {"next_id": 9}},
+    )
+    base.update(kw)
+    return StreamCheckpoint(**base)
+
+
+class _StubInstance:
+    """Duck-typed stand-in for PipelineInstance's checkpoint surface."""
+
+    def __init__(self, payload=None, apply_raises=False):
+        self._payload = payload if payload is not None else dict(
+            sched_class="standard",
+            trace_marker="",
+            frame_seq=3,
+            max_skip=8,
+            skips_at_capture=0,
+            fps=30.0,
+            stages={"track": {"next_id": 5}},
+        )
+        self.apply_raises = apply_raises
+        self.restored: list[tuple[StreamCheckpoint, bool]] = []
+
+    def checkpoint_payload(self):
+        return dict(self._payload)
+
+    def restore_checkpoint(self, ck, stale):
+        if self.apply_raises:
+            raise RuntimeError("stage refused the blob")
+        self.restored.append((ck, stale))
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("EVAM_FAULT_INJECT", raising=False)
+    monkeypatch.delenv("EVAM_FAULT_SEED", raising=False)
+    faults.reset_cache()
+    yield monkeypatch
+    faults.reset_cache()
+
+
+def _arm(monkeypatch, spec: str, seed: int = 0) -> None:
+    monkeypatch.setenv("EVAM_FAULT_INJECT", spec)
+    monkeypatch.setenv("EVAM_FAULT_SEED", str(seed))
+    faults.reset_cache()
+
+
+class TestWireFormat:
+    def test_round_trip_preserves_every_field(self):
+        ck = _ck()
+        blob = encode(ck)
+        assert is_checkpoint_blob(blob)
+        assert blob["v"] == SCHEMA_VERSION
+        back = decode(blob)
+        assert back == ck
+
+    def test_round_trip_survives_json(self):
+        import json
+
+        blob = json.loads(json.dumps(encode(_ck())))
+        assert decode(blob).stages["track"]["next_id"] == 9
+
+    def test_payload_tamper_raises_corrupt(self):
+        blob = encode(_ck())
+        blob["payload"]["stages"]["track"]["next_id"] = 10_000
+        with pytest.raises(CheckpointCorrupt):
+            decode(blob)
+
+    def test_crc_tamper_raises_corrupt(self):
+        blob = encode(_ck())
+        blob["crc"] ^= 0xDEADBEEF
+        with pytest.raises(CheckpointCorrupt):
+            decode(blob)
+
+    def test_unknown_version_raises(self):
+        blob = encode(_ck())
+        blob["v"] = SCHEMA_VERSION + 1
+        with pytest.raises(CheckpointVersionError):
+            decode(blob)
+
+    def test_non_envelope_shapes_rejected(self):
+        for bad in (None, [], "x", {}, {"v": 1}, {"payload": {}},
+                    {"v": 1, "crc": 0, "payload": "not-a-dict"}):
+            assert not is_checkpoint_blob(bad)
+        with pytest.raises(CheckpointCorrupt):
+            decode({"v": SCHEMA_VERSION, "crc": 0, "payload": "x"})
+
+    def test_legacy_stage_state_is_not_a_blob(self):
+        # the registry's streams.json legacy form: stage-name → dict
+        assert not is_checkpoint_blob({"track": {"next_id": 5}})
+
+
+class TestStaleness:
+    def test_no_gate_never_stale(self):
+        ck = _ck(max_skip=0, captured_at=time.time() - 3600)
+        assert not ck.is_stale()
+
+    def test_fresh_within_bound(self):
+        now = time.time()
+        # 2 skips banked + 0.1s * 30fps = 5 frames < max_skip 8
+        ck = _ck(captured_at=now - 0.1, skips_at_capture=2, fps=30.0,
+                 max_skip=8)
+        assert not ck.is_stale(now)
+
+    def test_elapsed_frames_cross_the_bound(self):
+        now = time.time()
+        # 2 skips + 0.5s * 30fps = 17 frames > max_skip 8
+        ck = _ck(captured_at=now - 0.5, skips_at_capture=2, fps=30.0,
+                 max_skip=8)
+        assert ck.is_stale(now)
+
+    def test_skips_at_capture_alone_can_exceed(self):
+        now = time.time()
+        ck = _ck(captured_at=now, skips_at_capture=9, max_skip=8)
+        assert ck.is_stale(now)
+
+
+class TestStore:
+    def test_capture_restore_round_trip(self, clean_faults):
+        store = CheckpointStore(interval=5)
+        src = _StubInstance()
+        store.register("s1", src)
+        blob = store.capture("s1", barrier="post_resolve")
+        assert blob is not None and is_checkpoint_blob(blob)
+        assert store.export("s1") == blob
+        dst = _StubInstance()
+        assert store.restore_into(blob, dst)
+        ck, stale = dst.restored[0]
+        assert not stale
+        assert ck.stream_id == "s1"
+        assert ck.stages["track"]["next_id"] == 5
+        s = store.summary()
+        assert s["captured"] == 1 and s["restored"] == 1
+        assert s["last_restore_ms"] >= 0.0
+
+    def test_unknown_stream_captures_nothing(self, clean_faults):
+        assert CheckpointStore().capture("ghost") is None
+
+    def test_unregister_drops_the_blob(self, clean_faults):
+        store = CheckpointStore()
+        inst = _StubInstance()  # held: registration is weak
+        store.register("s1", inst)
+        assert store.capture("s1") is not None
+        store.unregister("s1")
+        assert store.export("s1") is None
+        assert store.capture("s1") is None
+
+    def test_dead_instance_unregisters_itself(self, clean_faults):
+        store = CheckpointStore()
+        inst = _StubInstance()
+        store.register("s1", inst)
+        del inst  # weak registration: the stream's death is enough
+        assert store.capture("s1") is None
+
+    def test_migration_reason_counts(self, clean_faults):
+        store = CheckpointStore()
+        inst = _StubInstance()  # held: registration is weak
+        store.register("s1", inst)
+        before = metrics.get_counter(
+            "evam_stream_migrations", labels={"reason": "shard_loss"})
+        store.capture("s1", barrier="pre_rebalance", reason="shard_loss")
+        assert metrics.get_counter(
+            "evam_stream_migrations",
+            labels={"reason": "shard_loss"}) == before + 1
+        assert store.summary()["migrations"] == {"shard_loss": 1}
+        # steady-state refresh counts nothing
+        store.capture("s1", barrier="post_resolve")
+        assert store.summary()["migrations"] == {"shard_loss": 1}
+
+    def test_capture_all_covers_every_registered_stream(
+            self, clean_faults):
+        store = CheckpointStore()
+        keep = [_StubInstance() for _ in range(3)]
+        for i, inst in enumerate(keep):
+            store.register(f"s{i}", inst)
+        assert store.capture_all(barrier="pre_rebuild") == 3
+        assert store.summary()["held"] == 3
+
+    def test_corrupt_blob_cold_starts_loudly(self, clean_faults):
+        store = CheckpointStore()
+        blob = dict(encode(_ck()), crc=123)
+        before = metrics.get_counter(
+            "evam_ckpt_restore_failures", labels={"reason": "crc"})
+        dst = _StubInstance()
+        assert not store.restore_into(blob, dst)
+        assert dst.restored == []  # nothing applied
+        assert metrics.get_counter(
+            "evam_ckpt_restore_failures",
+            labels={"reason": "crc"}) == before + 1
+        assert store.summary()["restore_failures"] == {"crc": 1}
+
+    def test_version_skew_cold_starts(self, clean_faults):
+        store = CheckpointStore()
+        blob = dict(encode(_ck()), v=SCHEMA_VERSION + 7)
+        assert not store.restore_into(blob, _StubInstance())
+        assert store.summary()["restore_failures"] == {"version": 1}
+
+    def test_apply_failure_cold_starts(self, clean_faults):
+        store = CheckpointStore()
+        assert not store.restore_into(
+            encode(_ck()), _StubInstance(apply_raises=True))
+        assert store.summary()["restore_failures"] == {"apply": 1}
+
+    def test_stale_restore_keeps_identities_and_counts(
+            self, clean_faults):
+        store = CheckpointStore()
+        dst = _StubInstance()
+        stale_ck = _ck(captured_at=time.time() - 60)  # 1800 frames old
+        before = metrics.get_counter(
+            "evam_stream_migrations", labels={"reason": "stale_refresh"})
+        assert store.restore_into(encode(stale_ck), dst)
+        _, stale = dst.restored[0]
+        assert stale  # the instance prunes detections, keeps ids
+        assert metrics.get_counter(
+            "evam_stream_migrations",
+            labels={"reason": "stale_refresh"}) == before + 1
+
+    def test_injected_restore_stall_trips_the_timeout_rung(
+            self, clean_faults):
+        _arm(clean_faults, "restore_ms=80")
+        store = CheckpointStore(restore_timeout_s=0.01)
+        assert not store.restore_into(encode(_ck()), _StubInstance())
+        assert store.summary()["restore_failures"] == {"timeout": 1}
+
+    def test_injected_ckpt_corruption_poisons_the_blob(
+            self, clean_faults):
+        _arm(clean_faults, "ckpt_corrupt=1")
+        store = CheckpointStore()
+        inst = _StubInstance()  # held: registration is weak
+        store.register("s1", inst)
+        blob = store.capture("s1")
+        assert blob is not None
+        with pytest.raises(CheckpointCorrupt):
+            decode(blob)
+
+    def test_double_fault_kills_a_migration_capture(self, clean_faults):
+        _arm(clean_faults, "double_fault=1")
+        store = CheckpointStore()
+        inst = _StubInstance()  # held: registration is weak
+        store.register("s1", inst)
+        # steady-state capture is never double-faulted (reason=None)
+        assert store.capture("s1") is not None
+        # the migration-barrier capture dies; still counted as a move
+        assert store.capture("s1", barrier="pre_rebalance",
+                             reason="shard_loss") is None
+        s = store.summary()
+        assert s["restore_failures"] == {"double_fault": 1}
+        assert s["migrations"] == {"shard_loss": 1}
+
+    def test_stream_info_shape(self, clean_faults):
+        store = CheckpointStore()
+        inst = _StubInstance()  # held: registration is weak
+        store.register("s1", inst)
+        assert store.stream_info("s1") is None  # nothing held yet
+        store.capture("s1")
+        info = store.stream_info("s1")
+        assert info["held"] and info["v"] == SCHEMA_VERSION
+        assert info["barrier"] == "post_resolve"
+        assert not info["stale"]
+
+    def test_concurrent_captures_are_all_counted(self, clean_faults):
+        store = CheckpointStore()
+        keep = [_StubInstance() for _ in range(4)]  # registration is weak
+        for i, inst in enumerate(keep):
+            store.register(f"s{i}", inst)
+        n = 25
+        threads = [
+            threading.Thread(
+                target=lambda sid=f"s{i % 4}": [
+                    store.capture(sid) for _ in range(n)])
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert store.summary()["captured"] == 4 * n
+
+
+class TestKnob:
+    def test_off_is_memoized_none(self, monkeypatch):
+        monkeypatch.setenv("EVAM_CKPT", "off")
+        reset_settings()
+        reset_cache()
+        try:
+            assert active() is None
+            assert active() is None  # memo, not a re-read
+        finally:
+            monkeypatch.delenv("EVAM_CKPT", raising=False)
+            reset_settings()
+            reset_cache()
+
+    def test_on_resolves_configured_store(self, monkeypatch):
+        monkeypatch.setenv("EVAM_CKPT", "on")
+        monkeypatch.setenv("EVAM_CKPT_INTERVAL", "7")
+        monkeypatch.setenv("EVAM_CKPT_RESTORE_TIMEOUT_S", "0.5")
+        reset_settings()
+        reset_cache()
+        try:
+            store = active()
+            assert isinstance(store, CheckpointStore)
+            assert store.interval == 7
+            assert store.restore_timeout_s == 0.5
+            assert active() is store
+        finally:
+            for k in ("EVAM_CKPT", "EVAM_CKPT_INTERVAL",
+                      "EVAM_CKPT_RESTORE_TIMEOUT_S"):
+                monkeypatch.delenv(k, raising=False)
+            reset_settings()
+            reset_cache()
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("EVAM_CKPT", raising=False)
+        reset_settings()
+        reset_cache()
+        try:
+            assert active() is None
+        finally:
+            reset_settings()
+            reset_cache()

@@ -7,6 +7,7 @@ backoff, shutdown-drain leak accounting)."""
 from __future__ import annotations
 
 import asyncio
+import json
 import random
 import time
 from pathlib import Path
@@ -377,6 +378,59 @@ class TestShutdownDrain:
         assert leaked == 1
         assert metrics.get_gauge("evam_shutdown_leaked_streams") == 1
         inst.wait(timeout=10)  # reap the daemon before the next test
+
+    def test_straggler_checkpointed_not_leaked(self, eight_devices,
+                                               monkeypatch, tmp_path):
+        """EVAM_CKPT=on branch of the drain contract: a straggler that
+        outlives the drain budget is captured at the ``drain`` barrier
+        and persisted for resume instead of counted leaked."""
+        from evam_tpu import state as stream_state
+        from evam_tpu.config import reset_settings
+        from evam_tpu.state import is_checkpoint_blob
+
+        monkeypatch.setenv("EVAM_CKPT", "on")
+        reset_settings()
+        stream_state.reset_cache()
+        try:
+            settings = Settings(pipelines_dir=str(REPO / "pipelines"),
+                                state_dir=str(tmp_path),
+                                drain_timeout_s=0.2)
+            model_registry = ModelRegistry(
+                dtype="float32", input_overrides=SMALL,
+                width_overrides=NARROW)
+            hub = EngineHub(model_registry, plan=build_mesh(),
+                            max_batch=16, deadline_ms=4.0,
+                            wire_format="bgr")
+            reg = PipelineRegistry(settings, hub=hub)
+            assert reg._ckpt is not None
+            drain_moves0 = metrics.get_counter(
+                "evam_stream_migrations", labels={"reason": "drain"})
+            inst = reg.start_instance(
+                "video_decode", "app_dst",
+                {"source": {"type": "application"},
+                 "destination": {"metadata": {"type": "null"}}},
+                source=_StubbornSource(hold_s=3.0),
+            )
+            time.sleep(0.3)  # let the worker enter the stubborn read
+            t0 = time.time()
+            leaked = reg.stop_all()
+            assert time.time() - t0 < 2.5  # budget still honored
+            # checkpointed instead of leaked
+            assert leaked == 0
+            assert metrics.get_gauge("evam_shutdown_leaked_streams") == 0
+            assert metrics.get_counter(
+                "evam_stream_migrations",
+                labels={"reason": "drain"}) == drain_moves0 + 1
+            # and the persisted entry is a resumable checkpoint blob
+            entries = json.loads(
+                (tmp_path / "streams.json").read_text())
+            assert len(entries) == 1
+            assert is_checkpoint_blob(entries[0]["state"])
+            inst.wait(timeout=10)  # reap the daemon
+        finally:
+            monkeypatch.delenv("EVAM_CKPT", raising=False)
+            reset_settings()
+            stream_state.reset_cache()
 
     def test_clean_drain_counts_zero(self, eight_devices):
         settings = Settings(pipelines_dir=str(REPO / "pipelines"))

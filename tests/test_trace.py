@@ -26,7 +26,8 @@ from evam_tpu.obs.metrics import metrics
 
 _KNOBS = ("EVAM_TRACE", "EVAM_TRACE_SAMPLE_N", "EVAM_TRACE_RING",
           "EVAM_TRACE_SLOW_MS", "EVAM_TRACE_FLIGHT_DIR",
-          "EVAM_TRACE_FLIGHT_N")
+          "EVAM_TRACE_FLIGHT_N", "EVAM_TRACE_FLIGHT_MAX_FILES",
+          "EVAM_TRACE_FLIGHT_MAX_BYTES")
 
 
 def _fresh(monkeypatch, **env: str) -> None:
@@ -181,6 +182,48 @@ def test_flight_dump_shape(monkeypatch, tmp_path):
     import trace_dump
     events = trace_dump.events_from_flight(rows)
     assert any(e["cat"] == "batch" for e in events)
+
+
+def test_flight_dir_rotation_pins_file_cap(monkeypatch, tmp_path):
+    """A flapping engine must not grow the flight dir without bound:
+    after every dump the oldest flight-*.jsonl rotate out past
+    EVAM_TRACE_FLIGHT_MAX_FILES, and the just-written dump always
+    survives."""
+    _fresh(monkeypatch, EVAM_TRACE_FLIGHT_DIR=str(tmp_path),
+           EVAM_TRACE_FLIGHT_MAX_FILES="3",
+           EVAM_TRACE_FLIGHT_MAX_BYTES="0")
+    paths = [trace.flight_dump("det", f"flap {i}") for i in range(6)]
+    assert all(p is not None for p in paths)
+    kept = sorted(tmp_path.glob("flight-*.jsonl"))
+    assert len(kept) == 3
+    assert Path(paths[-1]) in kept          # freshest dump survives
+    assert Path(paths[0]) not in kept       # oldest rotated out
+    # an unrelated artifact in the dir is never touched
+    stray = tmp_path / "notes.txt"
+    stray.write_text("keep me")
+    trace.flight_dump("det", "flap 6")
+    assert stray.exists()
+    assert len(list(tmp_path.glob("flight-*.jsonl"))) == 3
+
+
+def test_flight_dir_rotation_pins_byte_cap(monkeypatch, tmp_path):
+    _fresh(monkeypatch, EVAM_TRACE_FLIGHT_DIR=str(tmp_path),
+           EVAM_TRACE_FLIGHT_MAX_FILES="0",
+           EVAM_TRACE_FLIGHT_MAX_BYTES="1")
+    # every dump is bigger than 1 byte, so each write prunes all
+    # older dumps — but never the file it just wrote
+    paths = [trace.flight_dump("det", f"flap {i}") for i in range(4)]
+    kept = list(tmp_path.glob("flight-*.jsonl"))
+    assert [str(p) for p in kept] == [paths[-1]]
+
+
+def test_flight_dir_rotation_zero_is_unbounded(monkeypatch, tmp_path):
+    _fresh(monkeypatch, EVAM_TRACE_FLIGHT_DIR=str(tmp_path),
+           EVAM_TRACE_FLIGHT_MAX_FILES="0",
+           EVAM_TRACE_FLIGHT_MAX_BYTES="0")
+    for i in range(8):
+        trace.flight_dump("det", f"flap {i}")
+    assert len(list(tmp_path.glob("flight-*.jsonl"))) == 8
 
 
 def test_runner_backdates_decode_span(monkeypatch):

@@ -204,6 +204,164 @@ def run_soak(
     }
 
 
+def run_shard_loss_soak(
+    streams: int = 4,
+    frames: int = 150,
+    shards: int = 4,
+    losses: int = 2,
+    seed: int = 11,
+    timeout_s: float = 240.0,
+) -> dict:
+    """Shard-loss-during-migration drill (crash-consistent state PR):
+    a sharded fleet (EVAM_FLEET=sharded) serves realtime streams with
+    checkpointing armed (EVAM_CKPT=on) when ``losses`` consecutive
+    chip losses fire (``shard_loss=1,shard_loss_n=K`` — deterministic,
+    the second loss lands while the first loss's streams are still
+    migrating). Contract:
+
+    * zero realtime failures: every stream COMPLETES — chip loss
+      degrades capacity, never a stream's liveness;
+    * no duplicate frame resolution: a frame failed over mid-dispatch
+      resolves at most once (per-stream frames_out <= frames_in);
+    * every migration is counted on
+      ``evam_stream_migrations_total{reason="shard_loss"}`` with a
+      pre-rebalance checkpoint banked for the moved stream.
+    """
+    import jax
+
+    from evam_tpu import state as stream_state
+    from evam_tpu.config import Settings
+    from evam_tpu.config.settings import reset_settings
+    from evam_tpu.engine import EngineHub
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+    from evam_tpu.obs import faults
+    from evam_tpu.obs.metrics import metrics
+    from evam_tpu.parallel import build_mesh
+    from evam_tpu.server.registry import PipelineRegistry
+
+    if len(jax.devices()) < shards:
+        raise RuntimeError(
+            f"need {shards} devices (XLA_FLAGS "
+            f"--xla_force_host_platform_device_count), have "
+            f"{len(jax.devices())}")
+    os.environ["EVAM_FAULT_INJECT"] = ""
+    os.environ["EVAM_CKPT"] = "on"
+    os.environ["EVAM_CKPT_INTERVAL"] = "10"
+    reset_settings()
+    faults.reset_cache()
+    stream_state.reset_cache()
+    try:
+        small = {k: (64, 64) for k in ZOO_SPECS}
+        small["audio_detection/environment"] = (1, 1600)
+        narrow = {k: 8 for k in ZOO_SPECS}
+        settings = Settings(pipelines_dir=str(REPO / "pipelines"))
+        hub = EngineHub(
+            ModelRegistry(dtype="float32", input_overrides=small,
+                          width_overrides=narrow),
+            plan=build_mesh(devices=list(jax.devices())[:shards]),
+            max_batch=16, deadline_ms=4.0, warmup=True,
+            supervise=True, max_restarts=3, restart_backoff_s=0.1,
+            fleet="sharded",
+        )
+        registry = PipelineRegistry(settings, hub=hub)
+        registry.preload("object_detection/person_vehicle_bike")
+        warm_deadline = time.time() + 180
+        while time.time() < warm_deadline:
+            ready = hub.readiness()
+            if ready["engines"] and not ready["warming"]:
+                break
+            time.sleep(0.1)
+        else:
+            registry.stop_all()
+            raise RuntimeError("fleet never warmed; cannot arm chaos")
+        # arm AFTER warmup: the loss must hit a serving shard, and the
+        # bounded countdown (shard_loss_n) retires exactly `losses`
+        # shards — the second while the first's streams are migrating
+        os.environ["EVAM_FAULT_INJECT"] = (
+            f"shard_loss=1,shard_loss_n={losses}")
+        os.environ["EVAM_FAULT_SEED"] = str(seed)
+        faults.reset_cache()
+        migrations0 = metrics.get_counter(
+            "evam_stream_migrations", labels={"reason": "shard_loss"})
+        losses0 = metrics.get_counter(
+            "evam_faults_injected", labels={"kind": "shard_loss"})
+        t0 = time.time()
+        try:
+            insts = [
+                registry.start_instance(
+                    "object_detection", "person_vehicle_bike",
+                    {
+                        "source": {
+                            "uri": f"synthetic://96x96@30?count={frames}"
+                                   f"&seed={i}",
+                            "type": "uri",
+                            "realtime": True,
+                        },
+                        "destination": {"metadata": {"type": "null"}},
+                        "priority": "realtime",
+                    },
+                )
+                for i in range(streams)
+            ]
+            deadline = t0 + timeout_s
+            for inst in insts:
+                inst.wait(timeout=max(1.0, deadline - time.time()))
+            states = [i.state.value for i in insts]
+            per_stream = {
+                i.id[:8]: {
+                    "in": i._runner.frames_in if i._runner else 0,
+                    "out": i._runner.frames_out if i._runner else 0,
+                    "errors": i._runner.errors if i._runner else 0,
+                } for i in insts
+            }
+            store = stream_state.active()
+            ckpt = store.summary() if store is not None else {}
+            fleet = hub.fleet_summary()
+        finally:
+            registry.stop_all()
+        migrations = metrics.get_counter(
+            "evam_stream_migrations",
+            labels={"reason": "shard_loss"}) - migrations0
+        shard_losses = metrics.get_counter(
+            "evam_faults_injected",
+            labels={"kind": "shard_loss"}) - losses0
+        # duplicate-resolution guard: a frame retried onto the new
+        # shard must not ALSO resolve on the dying one — resolved
+        # frames can never exceed ingested frames, per stream
+        duplicate_streams = [
+            sid for sid, row in per_stream.items()
+            if row["out"] > row["in"] or row["out"] > frames
+        ]
+        failed_rt = [s for s in states if s != "COMPLETED"]
+        ok = (
+            not failed_rt
+            and not duplicate_streams
+            and int(shard_losses) == losses
+            and int(migrations) >= 1
+            and fleet["degraded_shards"] >= losses
+            and sum(row["out"] for row in per_stream.values()) > 0
+        )
+        return {
+            "ok": ok,
+            "states": states,
+            "per_stream": per_stream,
+            "duplicate_streams": duplicate_streams,
+            "migrations": int(migrations),
+            "shard_losses_injected": int(shard_losses),
+            "fleet": fleet,
+            "checkpoint": ckpt,
+            "elapsed_s": round(time.time() - t0, 1),
+            "seed": seed,
+        }
+    finally:
+        for key in ("EVAM_FAULT_INJECT", "EVAM_FAULT_SEED",
+                    "EVAM_CKPT", "EVAM_CKPT_INTERVAL"):
+            os.environ.pop(key, None)
+        reset_settings()
+        faults.reset_cache()
+        stream_state.reset_cache()
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--streams", type=int, default=4)
@@ -216,13 +374,27 @@ def main() -> int:
     p.add_argument("--min-restarts", type=int, default=None,
                    help="override the wedge_n-derived recovery floor")
     p.add_argument("--timeout", type=float, default=240.0)
+    p.add_argument("--scenario", choices=("wedge", "shard-loss"),
+                   default="wedge",
+                   help="shard-loss: chip loss during migration on a "
+                        "sharded fleet with EVAM_CKPT=on")
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--losses", type=int, default=2)
     args = p.parse_args()
-    result = run_soak(
-        streams=args.streams, frames=args.frames, fault=args.fault,
-        seed=args.seed, stall_timeout_s=args.stall_timeout,
-        max_restarts=args.max_restarts, min_restarts=args.min_restarts,
-        timeout_s=args.timeout,
-    )
+    if args.scenario == "shard-loss":
+        result = run_shard_loss_soak(
+            streams=args.streams, frames=args.frames,
+            shards=args.shards, losses=args.losses, seed=args.seed,
+            timeout_s=args.timeout,
+        )
+    else:
+        result = run_soak(
+            streams=args.streams, frames=args.frames, fault=args.fault,
+            seed=args.seed, stall_timeout_s=args.stall_timeout,
+            max_restarts=args.max_restarts,
+            min_restarts=args.min_restarts,
+            timeout_s=args.timeout,
+        )
     print(json.dumps(result))
     return 0 if result["ok"] else 1
 

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Migration soak: crash-consistent stream state end to end.
+
+The acceptance drill for the checkpointed-state PR (evam_tpu/state/):
+three phases, each a state-loss path the StreamCheckpoint must cover,
+asserting the contract on a CPU host fleet
+(``--xla_force_host_platform_device_count``):
+
+A. **Live migration** — a sharded fleet (EVAM_FLEET=sharded) serves
+   realtime tracking streams (gate + IouTracker + coaster state live)
+   with EVAM_CKPT=on when a deliberate ``scale_down()`` retires one
+   chip mid-traffic. Every moved stream is checkpointed at the
+   pre-rebalance barrier and counted on
+   ``evam_stream_migrations_total{reason="scale_down"}``; zero
+   realtime streams fail; every held blob decodes (CRC + schema) and
+   is within the gate's max-skip staleness bound.
+
+B. **Crash-consistent restart** — streams are stopped via the drain
+   path (``stop_all``), which banks a drain-barrier checkpoint into
+   streams.json; a fresh registry ``resume()``s them and the restored
+   instances report ``restored_from`` with the tracker id high-water
+   mark preserved (identities never reset across a restart).
+
+C. **Corruption drill** — ``EVAM_FAULT_INJECT=ckpt_corrupt=1`` flips
+   the CRC on the banked checkpoint; the resume is a LOUD COLD START:
+   ``evam_ckpt_restore_failures_total{reason="crc"}`` increments, the
+   stream still starts and serves, and the engine restart budget is
+   untouched (no wedge, no supervisor burn).
+
+Exit 0 iff every phase holds. Prints ONE JSON line on stdout;
+diagnostics on stderr. ``--smoke`` is the CI shape (~small streams /
+short windows); the default shape is the soak-battery one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")
+os.environ.setdefault("EVAM_LOG_LEVEL", "warning")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+PIPELINE = ("object_tracking", "person_vehicle_bike")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _model_registry():
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+
+    small = {k: (64, 64) for k in ZOO_SPECS}
+    small["audio_detection/environment"] = (1, 1600)
+    return ModelRegistry(dtype="float32", input_overrides=small,
+                         width_overrides={k: 8 for k in ZOO_SPECS})
+
+
+def _build_registry(state_dir: str | None = None, shards: int = 0):
+    """A PipelineRegistry over a fresh hub: sharded fleet when
+    ``shards`` > 1, single-chip otherwise."""
+    import jax
+
+    from evam_tpu.config import Settings
+    from evam_tpu.engine import EngineHub
+    from evam_tpu.parallel import build_mesh
+    from evam_tpu.server.registry import PipelineRegistry
+
+    plan = (build_mesh(devices=list(jax.devices())[:shards])
+            if shards > 1 else build_mesh())
+    hub = EngineHub(
+        _model_registry(), plan=plan, max_batch=16, deadline_ms=4.0,
+        warmup=True, supervise=True, max_restarts=3,
+        restart_backoff_s=0.1,
+        fleet="sharded" if shards > 1 else "off",
+    )
+    settings = Settings(pipelines_dir=str(REPO / "pipelines"),
+                        state_dir=state_dir or "")
+    registry = PipelineRegistry(settings, hub=hub)
+    registry.preload(f"{PIPELINE[0]}/{PIPELINE[1]}")
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        ready = hub.readiness()
+        if ready["engines"] and not ready["warming"]:
+            return registry
+        time.sleep(0.1)
+    registry.stop_all()
+    raise RuntimeError("engines never warmed")
+
+
+def _start_streams(registry, n: int, frames: int, seed0: int = 0):
+    return [
+        registry.start_instance(
+            *PIPELINE,
+            {
+                "source": {
+                    "uri": f"synthetic://96x96@30?count={frames}"
+                           f"&seed={seed0 + i}",
+                    "type": "uri",
+                    "realtime": True,
+                },
+                "destination": {"metadata": {"type": "null"}},
+                "priority": "realtime",
+            },
+        )
+        for i in range(n)
+    ]
+
+
+def _tracker_next_ids(insts) -> dict[str, int]:
+    out = {}
+    for inst in insts:
+        for st in (inst.stage_state() or {}).values():
+            if isinstance(st, dict) and "next_id" in st:
+                out[inst.id] = int(st["next_id"])
+    return out
+
+
+def phase_live_migration(streams: int, frames: int, shards: int) -> dict:
+    """Phase A: scale_down() under live traffic."""
+    from evam_tpu import state as stream_state
+    from evam_tpu.obs.metrics import metrics
+    from evam_tpu.state import decode
+
+    registry = _build_registry(shards=shards)
+    store = stream_state.active()
+    mig0 = metrics.get_counter(
+        "evam_stream_migrations", labels={"reason": "scale_down"})
+    t0 = time.time()
+    try:
+        insts = _start_streams(registry, streams, frames)
+        time.sleep(max(1.5, frames / 30.0 * 0.3))
+        retired = []
+        for eng in list(registry.hub._engines.values()):
+            if hasattr(eng, "scale_down"):
+                label = eng.scale_down()
+                if label:
+                    retired.append(label)
+        log(f"phase A: retired shard(s) {retired} mid-traffic")
+        for inst in insts:
+            inst.wait(timeout=max(30.0, frames / 30.0 * 4))
+        states = [i.state.value for i in insts]
+        blobs = [store.export(i.id) for i in insts]
+        fleet = registry.hub.fleet_summary()
+    finally:
+        registry.stop_all()
+    mig = metrics.get_counter(
+        "evam_stream_migrations", labels={"reason": "scale_down"}) - mig0
+    decoded, stale, barriers = 0, 0, set()
+    for blob in blobs:
+        if blob is None:
+            continue
+        ck = decode(blob)  # raises on CRC/version damage
+        decoded += 1
+        barriers.add(ck.barrier)
+        if ck.is_stale():
+            stale += 1
+    failed = [s for s in states if s != "COMPLETED"]
+    ok = (not failed and int(mig) >= 1 and decoded >= 1 and stale == 0
+          and bool(retired))
+    return {
+        "ok": ok, "states": states, "migrations": int(mig),
+        "retired_shards": retired, "checkpoints_decoded": decoded,
+        "stale_checkpoints": stale, "barriers": sorted(barriers),
+        "fleet": fleet, "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def phase_resume(streams: int, frames: int) -> dict:
+    """Phase B: drain-checkpoint -> fresh registry resume()."""
+    from evam_tpu import state as stream_state
+    from evam_tpu.state import is_checkpoint_blob
+
+    state_dir = tempfile.mkdtemp(prefix="evam-migration-")
+    t0 = time.time()
+    registry = _build_registry(state_dir=state_dir)
+    insts = _start_streams(registry, streams, frames, seed0=100)
+    # let tracker/gate state accumulate past the capture interval
+    time.sleep(max(2.0, frames / 30.0 * 0.4))
+    pre_ids = _tracker_next_ids(insts)
+    leaked = registry.stop_all()
+    entries = json.loads(
+        (Path(state_dir) / "streams.json").read_text())
+    blob_entries = sum(
+        1 for e in entries if is_checkpoint_blob(e.get("state")))
+    store = stream_state.active()
+    restored0 = store.summary()["restored"]
+    registry2 = _build_registry(state_dir=state_dir)
+    try:
+        resumed = registry2.resume()
+        insts2 = list(registry2.instances.values())
+        restored_from = [
+            i.status().get("checkpoint", {}).get("restored_from")
+            for i in insts2
+        ]
+        post_ids = _tracker_next_ids(insts2)
+    finally:
+        registry2.stop_all()
+    restored = store.summary()["restored"] - restored0
+    # identity continuity: the resumed tracker id high-water mark is
+    # never BELOW what the first run had assigned
+    id_ok = (post_ids and pre_ids
+             and min(post_ids.values()) >= min(pre_ids.values()))
+    ok = (leaked == 0 and len(entries) == streams
+          and blob_entries == streams and resumed == streams
+          and restored >= streams
+          and all(r is not None for r in restored_from)
+          and bool(id_ok))
+    return {
+        "ok": ok, "leaked": leaked, "entries": len(entries),
+        "checkpoint_entries": blob_entries, "resumed": resumed,
+        "restored": int(restored), "restored_from": restored_from,
+        "pre_next_ids": pre_ids, "post_next_ids": post_ids,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def phase_corruption(frames: int) -> dict:
+    """Phase C: corrupted checkpoint -> loud cold start, no wedge."""
+    from evam_tpu.obs import faults
+    from evam_tpu.obs.metrics import metrics
+
+    state_dir = tempfile.mkdtemp(prefix="evam-migration-crc-")
+    t0 = time.time()
+    registry = _build_registry(state_dir=state_dir)
+    _start_streams(registry, 1, frames, seed0=200)
+    time.sleep(2.0)
+    # arm corruption for the DRAIN capture only: the banked blob's CRC
+    # is flipped, so the resume side must take the crc rung of the
+    # degradation ladder
+    os.environ["EVAM_FAULT_INJECT"] = "ckpt_corrupt=1"
+    faults.reset_cache()
+    registry.stop_all()
+    os.environ["EVAM_FAULT_INJECT"] = ""
+    faults.reset_cache()
+    crc0 = metrics.get_counter(
+        "evam_ckpt_restore_failures", labels={"reason": "crc"})
+    registry2 = _build_registry(state_dir=state_dir)
+    restarts0 = registry2.hub.readiness()["restarts"]
+    try:
+        resumed = registry2.resume()
+        time.sleep(1.0)
+        ready = registry2.hub.readiness()
+        states = [i.state.value for i in registry2.instances.values()]
+    finally:
+        registry2.stop_all()
+    crc_failures = metrics.get_counter(
+        "evam_ckpt_restore_failures", labels={"reason": "crc"}) - crc0
+    ok = (
+        resumed == 1
+        and int(crc_failures) >= 1              # loud
+        and ready["restarts"] - restarts0 == 0  # no budget burn
+        and ready["degraded"] == 0              # no wedge
+        and all(s in ("RUNNING", "COMPLETED") for s in states)
+    )
+    return {
+        "ok": ok, "resumed": resumed, "crc_failures": int(crc_failures),
+        "restart_delta": ready["restarts"] - restarts0,
+        "degraded": ready["degraded"], "states": states,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI shape: small streams, short windows")
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--frames", type=int, default=240)
+    ap.add_argument("--shards", type=int, default=4)
+    args = ap.parse_args()
+    if args.smoke:
+        args.streams, args.frames, args.shards = 2, 150, 3
+
+    from evam_tpu import state as stream_state
+    from evam_tpu.config.settings import reset_settings
+    from evam_tpu.obs import faults
+
+    os.environ["EVAM_CKPT"] = "on"
+    os.environ["EVAM_CKPT_INTERVAL"] = "5"
+    os.environ["EVAM_GATE"] = "on"
+    os.environ["EVAM_FAULT_INJECT"] = ""
+    reset_settings()
+    faults.reset_cache()
+    stream_state.reset_cache()
+    try:
+        a = phase_live_migration(args.streams, args.frames, args.shards)
+        log(f"phase A (live migration): {a}")
+        b = phase_resume(args.streams, args.frames)
+        log(f"phase B (resume): {b}")
+        c = phase_corruption(args.frames)
+        log(f"phase C (corruption): {c}")
+    finally:
+        for key in ("EVAM_CKPT", "EVAM_CKPT_INTERVAL", "EVAM_GATE",
+                    "EVAM_FAULT_INJECT"):
+            os.environ.pop(key, None)
+        reset_settings()
+        faults.reset_cache()
+        stream_state.reset_cache()
+    ok = a["ok"] and b["ok"] and c["ok"]
+    print(json.dumps({
+        "metric": "migration_soak_failed_phases",
+        "value": sum(1 for p in (a, b, c) if not p["ok"]),
+        "unit": "phases",
+        "vs_baseline": 0.0,
+        "ok": ok,
+        "live_migration": a,
+        "resume": b,
+        "corruption": c,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
