@@ -106,6 +106,13 @@ class TPUSettings(BaseModel):
     #: count) — a chip serving 1/N of the streams doesn't need the
     #: fleet-wide max_batch worth of compile bill and staging memory
     fleet_shard_max_batch: int = 0
+    #: fleet autoscaling ceiling (the eighth control law): the fleet
+    #: may grow up to this many shards (bounded by the mesh) when
+    #: utilization stays over EVAM_TUNE_SCALE_UP_UTIL, and drains back
+    #: when it stays under EVAM_TUNE_SCALE_DOWN_UTIL. 0 (default)
+    #: keeps the law inert — the fleet stays at EVAM_FLEET_SHARDS.
+    #: Note EVAM_FLEET_SHARDS names the BOOT size, not a pin.
+    fleet_max_shards: int = 0
 
 
 class SchedSettings(BaseModel):
@@ -226,6 +233,35 @@ class TuneSettings(BaseModel):
     #: utilization below which it relaxes back toward the static
     #: operating point (dead band between util_lo and util_hi)
     util_lo: float = 0.50
+    #: eighth law (autoscaling, needs EVAM_FLEET_MAX_SHARDS > 0):
+    #: fleet utilization sustained ABOVE this for `damping` ticks
+    #: spawns one shard from the AOT cache — deliberately above
+    #: util_hi so the in-shard laws (deadlines, gate, admission) get
+    #: to absorb pressure before the fleet pays for a new chip
+    scale_up_util: float = 0.90
+    #: sustained utilization BELOW this drains one shard through
+    #: scale_down() + checkpointed stream migration; deliberately
+    #: below util_lo so grow/shrink never oscillate across one band
+    scale_down_util: float = 0.30
+
+
+class AotSettings(BaseModel):
+    """Persistent AOT executable cache (evam_tpu/aot/): serialized
+    compiled executables in a content-addressed, CRC-guarded,
+    size-capped on-disk store shared by supervisor rebuilds, fleet
+    shard spin-up and every warmup path. ``EVAM_AOT=off`` (default
+    until proven) disables the whole layer — byte-identical A/B
+    (tools/bench_aot.py), same discipline as EVAM_TRANSFER /
+    EVAM_GATE / EVAM_TRACE / EVAM_CKPT."""
+
+    enabled: bool = False
+    #: cache directory; empty = <tmpdir>/evam_aot. Share it across
+    #: processes/containers on one host — entries are atomic and
+    #: content-addressed, concurrent writers converge.
+    dir: str = ""
+    #: size cap in bytes (LRU by mtime past it; the newest entry
+    #: always survives). Default 1 GiB.
+    max_bytes: int = 1073741824
 
 
 class Settings(BaseModel):
@@ -272,6 +308,7 @@ class Settings(BaseModel):
     trace: TraceSettings = Field(default_factory=TraceSettings)
     tune: TuneSettings = Field(default_factory=TuneSettings)
     ckpt: CkptSettings = Field(default_factory=CkptSettings)
+    aot: AotSettings = Field(default_factory=AotSettings)
 
     @classmethod
     def from_env(cls, config_file: str | os.PathLike | None = None) -> "Settings":
@@ -325,6 +362,7 @@ class Settings(BaseModel):
             "EVAM_FLEET": ("fleet", str),
             "EVAM_FLEET_SHARDS": ("fleet_shards", int),
             "EVAM_FLEET_SHARD_MAX_BATCH": ("fleet_shard_max_batch", int),
+            "EVAM_FLEET_MAX_SHARDS": ("fleet_max_shards", int),
         }
         if isinstance(tpu, dict):
             for var, (key, conv) in tpu_mapping.items():
@@ -387,11 +425,24 @@ class Settings(BaseModel):
             "EVAM_TUNE_COOLDOWN": ("cooldown", int),
             "EVAM_TUNE_UTIL_HI": ("util_hi", float),
             "EVAM_TUNE_UTIL_LO": ("util_lo", float),
+            "EVAM_TUNE_SCALE_UP_UTIL": ("scale_up_util", float),
+            "EVAM_TUNE_SCALE_DOWN_UTIL": ("scale_down_util", float),
         }
         if isinstance(tune, dict):
             for var, (key, conv) in tune_mapping.items():
                 if var in env:
                     tune[key] = conv(env[var])
+
+        aot = data.setdefault("aot", {})
+        aot_mapping = {
+            "EVAM_AOT": ("enabled", _parse_bool),
+            "EVAM_AOT_DIR": ("dir", str),
+            "EVAM_AOT_MAX_BYTES": ("max_bytes", int),
+        }
+        if isinstance(aot, dict):
+            for var, (key, conv) in aot_mapping.items():
+                if var in env:
+                    aot[key] = conv(env[var])
         return cls.model_validate(data)
 
 

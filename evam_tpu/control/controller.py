@@ -73,7 +73,7 @@ class TuneController:
 
     KNOBS = ("deadline_scale", "batch_cap", "transfer_depth",
              "gate_scale", "admit_util", "capacity_fps",
-             "staleness_scale")
+             "staleness_scale", "fleet_shards")
 
     def __init__(self, hub, state: TuneState, admission=None) -> None:
         self.hub = hub
@@ -100,6 +100,12 @@ class TuneController:
             "staleness_scale": bool(
                 {"staleness_ms_realtime", "staleness_ms_standard",
                  "staleness_ms_batch"} & sset),
+            # EVAM_FLEET_SHARDS names the BOOT fleet size, not a pin —
+            # pinning on it would disable autoscaling for exactly the
+            # deployments that set an initial size. The opt-in/out is
+            # EVAM_FLEET_MAX_SHARDS: max_shards 0 keeps the law inert
+            # (the gate_scale discipline — never pinned here).
+            "fleet_shards": False,
         }
         self.static_transfer_depth = max(1, int(s.tpu.transfer_depth))
         self.static_admit_util = float(s.sched.admit_util)
@@ -188,6 +194,16 @@ class TuneController:
                 self.admission.capacity_fps(live=True))
             sig["demand_fps"] = float(
                 self.admission.effective_demand_fps())
+        # fleet autoscaling inputs (eighth law): guarded getattr —
+        # unit-test hubs and the off mode simply leave the zeros
+        fleet_fn = getattr(self.hub, "fleet_summary", None)
+        if fleet_fn is not None:
+            try:
+                fs = fleet_fn()
+                sig["fleet_shards"] = float(fs.get("shards", 0))
+                sig["fleet_max_shards"] = float(fs.get("max_shards", 0))
+            except Exception:
+                log.exception("fleet summary unavailable")
         return sig
 
     def _demand_p95(self, buckets: dict[str, float]) -> float:
@@ -392,4 +408,35 @@ class TuneController:
             out.append(("staleness_scale",
                         round(min(1.0, cur / STALENESS_FACTOR), 4),
                         "headroom: relax staleness budgets"))
+
+        # fleet_shards (the eighth law): sustained saturation spawns a
+        # shard from the AOT cache, sustained idleness drains one via
+        # scale_down + checkpointed migration. Thresholds sit OUTSIDE
+        # the util_hi/util_lo band on purpose — the in-shard laws get
+        # to absorb pressure before the fleet buys a chip, and the
+        # damping/cooldown machinery downstream paces each move.
+        # max_shards 0 (EVAM_FLEET_MAX_SHARDS unset / fleet off) keeps
+        # the law inert.
+        maxs = int(sig["fleet_max_shards"])
+        live_shards = int(sig["fleet_shards"])
+        if maxs > 0 and live_shards > 0:
+            up = float(self.cfg.scale_up_util)
+            down = float(self.cfg.scale_down_util)
+            if util >= up and live_shards < maxs:
+                out.append(("fleet_shards", live_shards + 1,
+                            f"utilization {util:.2f} >= {up:.2f} "
+                            f"sustained: spawn shard "
+                            f"{live_shards + 1}/{maxs} from the AOT "
+                            f"cache"))
+            elif util <= down and live_shards > 1:
+                out.append(("fleet_shards", live_shards - 1,
+                            f"utilization {util:.2f} <= {down:.2f} "
+                            f"sustained: drain one shard "
+                            f"(checkpointed migration)"))
+            elif old.fleet_shards and old.fleet_shards != live_shards \
+                    and down < util < up:
+                # target reached or overtaken inside the dead band:
+                # rest the knob so retune stops re-actuating
+                out.append(("fleet_shards", live_shards,
+                            "fleet at rest: track live shard count"))
         return out

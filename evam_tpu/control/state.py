@@ -58,6 +58,11 @@ class OperatingPoint:
     #: multiplier on per-class staleness budgets: <1 sheds earlier
     #: under sustained overload
     staleness_scale: float = 1.0
+    #: target fleet size (eighth law, 0 = "no target" — the fleet
+    #: stays wherever it is). FleetEngine.retune moves ONE shard per
+    #: push toward it: grow = build-from-AOT-cache + warm-before-join,
+    #: shrink = scale_down + checkpointed migration.
+    fleet_shards: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -68,6 +73,7 @@ class OperatingPoint:
             "admit_util": self.admit_util,
             "capacity_fps": self.capacity_fps,
             "staleness_scale": self.staleness_scale,
+            "fleet_shards": self.fleet_shards,
         }
 
 
@@ -84,6 +90,10 @@ ZERO_SIGNALS = {
     "batch_p95": 0.0,
     "capacity_fps": 0.0,
     "demand_fps": 0.0,
+    # fleet autoscaling inputs (eighth law): live shard count and the
+    # operator ceiling (0 = law inert, EVAM_FLEET_MAX_SHARDS unset)
+    "fleet_shards": 0.0,
+    "fleet_max_shards": 0.0,
 }
 
 

@@ -69,6 +69,8 @@ from typing import Callable
 import jax
 import numpy as np
 
+from evam_tpu.aot import active as aot_active
+from evam_tpu.aot import cache_key as aot_cache_key
 from evam_tpu.control.state import current_op
 from evam_tpu.engine import devlock
 from evam_tpu.engine.ragged import (
@@ -159,6 +161,14 @@ class EngineStats:
     #: drop" claim is measured against these, not asserted.
     compiled_programs: int = 0
     compile_seconds: float = 0.0
+    #: AOT-cache attribution (evam_tpu/aot/): buckets warmed from a
+    #: deserialized executable instead of a jit trace + XLA compile,
+    #: and the wall seconds those loads+validations took — the warm
+    #: counterpart of compile_seconds, so /engines shows cold vs warm
+    #: spin-up honestly (a cache-hit shard: aot_hits == buckets,
+    #: compile_seconds ≈ 0)
+    aot_hits: int = 0
+    aot_load_seconds: float = 0.0
     #: submits past the top bucket that had to be split across batches
     #: instead of silently clamped (oversize-split contract)
     oversize_splits: int = 0
@@ -190,6 +200,8 @@ class EngineStats:
         self.unit_slots += other.unit_slots
         self.compiled_programs += other.compiled_programs
         self.compile_seconds += other.compile_seconds
+        self.aot_hits += other.aot_hits
+        self.aot_load_seconds += other.aot_load_seconds
         self.oversize_splits += other.oversize_splits
         for b, c in other.bucket_batches.items():
             self.bucket_batches[b] = self.bucket_batches.get(b, 0) + c
@@ -226,6 +238,7 @@ class BatchEngine:
         "_buckets_done": "_exec_lock",
         "_outstanding": "_exec_lock",
         "_next_batch_id": "_exec_lock",
+        "_aot_exec": "_exec_lock",
     }
 
     def __init__(
@@ -249,6 +262,7 @@ class BatchEngine:
         ragged_spec: RaggedSpec | None = None,
         fleet_local: bool = False,
         transfer_depth: int | None = None,
+        aot_key: str | None = None,
     ):
         self.name = name
         self.plan = plan
@@ -361,6 +375,16 @@ class BatchEngine:
             int, tuple[float, list[_WorkItem], int, float]] = {}
         self._next_batch_id = 0
         self._exec_lock = threading.Lock()
+        #: persistent AOT executable cache (evam_tpu/aot/): the hub's
+        #: program fingerprint for this engine — part of the cache key
+        #: together with shapes/devices/donation. None (the EVAM_AOT
+        #: default, or a caller that never passes it) keeps warmup and
+        #: dispatch byte-identical to the plain jit path.
+        self._aot_key = aot_key
+        #: bucket → validated AOT executable, installed by warmup;
+        #: dispatch (``_exec_for``) prefers it over the jitted step —
+        #: both share the ``fn(params, *arrays)`` call signature.
+        self._aot_exec: dict[int, object] = {}
 
         d = plan.data_size if plan else 1
         top = plan.pad_batch(max_batch) if plan else max_batch
@@ -425,6 +449,9 @@ class BatchEngine:
             donate_inputs = jax.default_backend() == "tpu"
         donate = (tuple(range(1, 1 + len(input_names)))
                   if donate_inputs else ())
+        #: kept for the AOT cache key — donation changes the compiled
+        #: artifact (aliased buffers), so it must address the entry
+        self._donate = donate
 
         if plan is not None:
             self._params = jax.device_put(params, plan.replicated())
@@ -653,13 +680,26 @@ class BatchEngine:
             self._upload_q.set_depth(self.transfer_depth)
 
     def warmup(self) -> None:
-        """Compile every bucket size ahead of traffic."""
+        """Compile every bucket size ahead of traffic.
+
+        With the AOT cache active (EVAM_AOT=on and an ``aot_key``),
+        each rung first tries a deserialized executable from the
+        persistent store (validated by actually running the warm
+        batch through it); a hit skips trace+compile entirely, a miss
+        compiles ahead-of-time once and populates the store. Any
+        failure on that path falls through to the plain jit warmup
+        below — the cache can degrade serving to cold, never to
+        broken."""
         example = self._example_item()
+        cache = aot_active() if self._aot_key else None
         for b in self.buckets:
             batch = self._warm_batch(example, b)
+            t0 = time.perf_counter()
+            if cache is not None and self._warm_bucket_aot(
+                    cache, b, batch, t0):
+                continue
             # whole compile+execute+readback under one devlock span:
             # a warmup must never leave a half-overlapped RPC behind
-            t0 = time.perf_counter()
             with devlock.device_call(f"{self.name}:warmup"):
                 np.asarray(self._run(batch))
             with self._exec_lock:
@@ -675,6 +715,108 @@ class BatchEngine:
                 # (not first-batch-grace) watchdog budget from here on
                 self._buckets_done.add(b)
         log.info("engine %s warmed %d buckets %s", self.name, len(self.buckets), self.buckets)
+
+    # ------------------------------------------- AOT cache (evam_tpu/aot/)
+
+    def _aot_bucket_key(self, b: int,
+                        batch: dict[str, np.ndarray]) -> str:
+        """Cache key for bucket ``b``'s executable: the hub program
+        fingerprint + the exact step-input shapes/dtypes + the params
+        aval signature + the device set the executable binds to +
+        donation + backend. Fleet-local sub rungs address different
+        entries than the mesh rungs by their single-device list."""
+        plan = (self._local_plan
+                if (self._fleet_local and 0 < b < self.plan.data_size)
+                else self.plan)
+        if plan is not None:
+            devices = [str(d) for d in plan.mesh.devices.flat]
+        else:
+            devices = [str(jax.devices()[0])]
+        inputs = [(name, tuple(batch[name].shape),
+                   str(batch[name].dtype))
+                  for name in self._step_inputs]
+        params_sig = [
+            (tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", "")))
+            for leaf in jax.tree_util.tree_leaves(self._params)]
+        return aot_cache_key(self._aot_key, b, inputs, params_sig,
+                             devices, self._donate,
+                             jax.default_backend())
+
+    def _aot_arrays(self, b: int, batch: dict[str, np.ndarray]):
+        """(params, placed input arrays) for bucket ``b`` — the same
+        placement ``_run`` performs, shared by the AOT validate and
+        populate paths."""
+        _, prm, sharding = self._exec_plain(b)
+        arrays = []
+        for name in self._step_inputs:
+            a = batch[name]
+            if sharding is not None:
+                a = jax.device_put(a, sharding)
+            arrays.append(a)
+        return prm, arrays
+
+    def _warm_bucket_aot(self, cache, b: int,
+                         batch: dict[str, np.ndarray],
+                         t0: float) -> bool:
+        """Warm bucket ``b`` through the AOT cache. True = the rung is
+        warmed (hit, or compiled+stored); False = fall back to the
+        plain jit warmup. Hits bank into aot_hits/aot_load_seconds,
+        misses into compile_seconds — /engines attributes cold vs
+        warm spin-up from exactly these."""
+        key = self._aot_bucket_key(b, batch)
+        compiled = None
+        with devlock.device_call(f"{self.name}:warmup"):
+            prm, arrays = self._aot_arrays(b, batch)
+            loaded = cache.load(key, engine=self.name)
+            if loaded is not None:
+                try:
+                    # the only honest validation of a deserialized,
+                    # device-bound executable is running it — this IS
+                    # the warm run on success
+                    np.asarray(loaded(prm, *arrays))
+                except Exception as exc:  # noqa: BLE001 — device/placement drift
+                    log.warning(
+                        "engine %s: cached AOT executable for bucket "
+                        "%d would not execute (%s) — recompiling",
+                        self.name, b, exc)
+                    cache.execute_miss(key, engine=self.name)
+                    loaded = None
+            if loaded is not None:
+                with self._exec_lock:
+                    if b not in self._buckets_done:
+                        self.stats.compiled_programs += 1
+                        self.stats.aot_hits += 1
+                        self.stats.aot_load_seconds += (
+                            time.perf_counter() - t0)
+                    self._aot_exec[b] = loaded
+                    self._buckets_done.add(b)
+                cache.hit(engine=self.name)
+                return True
+            try:
+                # miss: compile ahead-of-time ONCE (lower().compile()
+                # and jit don't share a cache — running both would
+                # double the cold-start bill) and use the compiled
+                # executable for the warm run and for dispatch
+                jit_fn, _, _ = self._exec_plain(b)
+                compiled = jit_fn.lower(prm, *arrays).compile()
+                np.asarray(compiled(prm, *arrays))
+            except Exception as exc:  # noqa: BLE001 — AOT unsupported here
+                log.warning(
+                    "engine %s: AOT compile path failed for bucket %d "
+                    "(%s) — plain jit warmup", self.name, b, exc)
+                return False
+            with self._exec_lock:
+                if b not in self._buckets_done:
+                    self.stats.compiled_programs += 1
+                    self.stats.compile_seconds += (
+                        time.perf_counter() - t0)
+                self._aot_exec[b] = compiled
+                self._buckets_done.add(b)
+        # serialize+write outside the devlock span — disk I/O must not
+        # serialize against other engines' device calls
+        cache.store(key, compiled, engine=self.name)
+        return True
 
     def _warm_batch(self, example: dict[str, np.ndarray],
                     b: int) -> dict[str, np.ndarray]:
@@ -873,7 +1015,7 @@ class BatchEngine:
         self._count_oversize_split(len(chunks) - 1)
         return chunks
 
-    def _exec_for(self, b: int):
+    def _exec_plain(self, b: int):
         """(jit, params, sharding) for one sealed bucket. With the
         fleet mode's local bypass, sub-data-size buckets select the
         single-device twin — the existing bucket fn already routed the
@@ -885,6 +1027,18 @@ class BatchEngine:
         if self.plan is not None:
             return self._jit_step, self._params, self.plan.batch_sharding()
         return self._jit_step, self._params, None
+
+    def _exec_for(self, b: int):
+        """(callable, params, sharding) for one sealed bucket — the
+        warmed AOT executable when the cache installed one for this
+        rung, the jitted step otherwise. Both share the
+        ``fn(params, *arrays)`` call signature, so every dispatch
+        path is agnostic to which it got. (Lock-free read: dict get
+        is atomic and a rung's entry, once installed by warmup, is
+        never replaced.)"""
+        jit_fn, prm, sharding = self._exec_plain(b)
+        exe = self._aot_exec.get(b)
+        return (exe if exe is not None else jit_fn), prm, sharding
 
     def _run(self, batch: dict[str, np.ndarray],
              clock: dict[str, float] | None = None):

@@ -60,6 +60,8 @@ class EngineHub:
         ragged_unit_budget: int = 0,
         fleet: str | None = None,
         fleet_shard_max_batch: int = 0,
+        fleet_max_shards: int = 0,
+        fleet_initial_shards: int = 0,
     ):
         #: serving sets True: stages precompile every batch bucket in
         #: the background right after engine creation
@@ -144,6 +146,17 @@ class EngineHub:
         self.fleet_shard_max_batch = fleet_shard_max_batch or (
             max(1, max_batch // plan.data_size) if self.fleet_active
             else max_batch)
+        #: autoscaling ceiling (EVAM_FLEET_MAX_SHARDS): how many
+        #: shards the eighth control law may grow the fleet to,
+        #: bounded by the mesh. 0 (default) keeps the law inert —
+        #: fleet_summary reports max_shards 0 and the controller
+        #: never proposes a move.
+        self.fleet_max_shards = fleet_max_shards
+        #: boot fleet size (EVAM_FLEET_SHARDS when autoscaling):
+        #: FleetEngines start with this many shards and grow/shrink
+        #: between 1 and the ceiling. 0 = all plan devices (the
+        #: pre-autoscaling behavior).
+        self.fleet_initial_shards = fleet_initial_shards
         self._engines: dict[str, BatchEngine | SupervisedEngine] = {}
         #: device_synth only: engine key → the (H, W) its on-chip
         #: generator was compiled for (cache-hit mismatch guard)
@@ -262,6 +275,18 @@ class EngineHub:
         shard individually supervised, so a wedge on one chip is that
         shard's quarantine, not the fleet's."""
 
+        # AOT cache program fingerprint (evam_tpu/aot/): everything at
+        # the hub level that changes what the step COMPUTES. Shapes,
+        # devices, donation and params avals are appended per bucket
+        # by the engine (BatchEngine._aot_bucket_key) — so supervisor
+        # rebuilds and fleet shard spin-ups of the same program land
+        # on the same entries, while a wire-format or ragged-mode flip
+        # addresses different ones.
+        aot_key = (f"{key}|wire={self.wire_format}"
+                   f"|synth={int(self.device_synth)}"
+                   f"|ragged={self.ragged}|ub={self.ragged_unit_budget}"
+                   f"|sched={int(self.sched is not None)}")
+
         def make(plan, name, max_batch, fleet_local=False):
             def factory() -> BatchEngine:
                 return BatchEngine(
@@ -280,6 +305,7 @@ class EngineHub:
                     ragged=self.ragged,
                     ragged_spec=ragged_spec,
                     fleet_local=fleet_local,
+                    aot_key=aot_key,
                 )
 
             if not self.supervise:
@@ -301,6 +327,7 @@ class EngineHub:
             plans=self.plan.per_device_plans(),
             mesh_factory=lambda label: make(
                 self.plan, label, self.max_batch, fleet_local=True),
+            initial=self.fleet_initial_shards,
         )
 
     def _check_synth_hw(self, key: str, synth_hw) -> None:
@@ -359,6 +386,12 @@ class EngineHub:
                     e.stats.bucket_batches.items())},
             "compiled_programs": e.stats.compiled_programs,
             "compile_s": round(e.stats.compile_seconds, 3),
+            # cold-vs-warm spin-up attribution (evam_tpu/aot/): rungs
+            # warmed from the persistent executable cache and what
+            # those loads cost — a cache-hit shard shows hits ==
+            # compiled_programs and compile_s ≈ 0
+            "aot": {"hits": e.stats.aot_hits,
+                    "load_s": round(e.stats.aot_load_seconds, 3)},
             "oversize_splits": e.stats.oversize_splits,
             # per-batch host clock means (ringbuf.STAGES order)
             "stage_ms": e.stats.stage_ms_per_batch(),
@@ -533,6 +566,9 @@ class EngineHub:
             "degraded_shards": 0,
             "rebalances": 0,
             "streams": {},
+            "max_shards": 0,
+            "scale_ups": 0,
+            "scale_downs": 0,
         }
         for e in engines:
             if not hasattr(e, "shard_rows"):  # FleetEngine only
@@ -545,8 +581,23 @@ class EngineHub:
             out["degraded_shards"] = max(
                 out["degraded_shards"], s["degraded_shards"])
             out["rebalances"] += s["rebalances"]
+            out["max_shards"] = max(out["max_shards"],
+                                    s.get("max_shards", 0))
+            out["scale_ups"] += s.get("scale_ups", 0)
+            out["scale_downs"] += s.get("scale_downs", 0)
             for label, n in s["streams"].items():
                 out["streams"][label] = out["streams"].get(label, 0) + n
+        # autoscaling policy ceiling: the structural bound above is
+        # the mesh (len(plans)); the operator's EVAM_FLEET_MAX_SHARDS
+        # clamps it, and 0 — the default — disables the eighth law
+        # (the controller treats max_shards 0 as "never scale")
+        if self.fleet_active and self.fleet_max_shards > 0:
+            cap = self.fleet_max_shards
+            if out["max_shards"]:
+                cap = min(cap, out["max_shards"])
+            out["max_shards"] = cap
+        else:
+            out["max_shards"] = 0
         return out
 
     def retune(self, op) -> None:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from evam_tpu.engine.batcher import EngineStats
 from evam_tpu.fleet.placer import ConsistentHashPlacer
@@ -94,7 +95,13 @@ class FleetEngine:
         "shards": "_lock",
         "_pins": "_lock",
         "_degraded": "_lock",
+        "_retired_planned": "_lock",
+        "_devices": "_lock",
         "rebalances": "_lock",
+        "scale_ups": "_lock",
+        "scale_downs": "_lock",
+        "_scaling": "_lock",
+        "_last_spinup_s": "_lock",
         "_stats_carry": "_lock",
         "_shed_carry": "_lock",
         "_restarts_carry": "_lock",
@@ -104,7 +111,8 @@ class FleetEngine:
     }
 
     def __init__(self, name: str, shard_factory, plans,
-                 mesh_factory=None, vnodes: int = 512):
+                 mesh_factory=None, vnodes: int = 512,
+                 initial: int = 0):
         if not plans:
             raise ValueError(f"fleet engine {name}: no shard plans")
         self.name = name
@@ -112,9 +120,19 @@ class FleetEngine:
         self._mesh_eng = None
         self._mesh_lock = threading.Lock()
         self._lock = threading.RLock()
+        #: full per-device plan list — the structural scale ceiling;
+        #: ``initial`` (autoscaling boot size, EVAM_FLEET_SHARDS when
+        #: EVAM_FLEET_MAX_SHARDS is set) builds only the first n and
+        #: leaves the rest for scale_up()
+        self._plans = list(plans)
+        self._shard_factory = shard_factory
+        self._vnodes = vnodes
+        n = len(self._plans)
+        if initial > 0:
+            n = max(1, min(initial, n))
         self.shards: dict[str, object] = {}
         self._devices: dict[str, str] = {}
-        for i, plan in enumerate(plans):
+        for i, plan in enumerate(self._plans[:n]):
             label = f"s{i}"
             self.shards[label] = shard_factory(plan, f"{name}@{label}")
             self._devices[label] = str(plan.mesh.devices.flat[0])
@@ -123,8 +141,20 @@ class FleetEngine:
         #: sticky; the placer alone would already be deterministic,
         #: the pin makes MOVES observable so they can be counted)
         self._pins: dict[str, str] = {}
+        #: chip-loss retirements: the plan index is DEAD — scale_up
+        #: never reuses these labels. Planned scale-downs land in
+        #: _retired_planned instead (healthy chip, reusable slot).
         self._degraded: set[str] = set()
+        self._retired_planned: set[str] = set()
         self.rebalances = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        #: one spin-up at a time (warm-before-join can take seconds;
+        #: a second concurrent grow must queue behind the controller's
+        #: next tick, not race the first)
+        self._scaling = False
+        #: last scale_up's build+warm wall seconds (soak/bench probe)
+        self._last_spinup_s = 0.0
         #: retired-shard carry (supervisor discipline, fleet level)
         self._stats_carry: EngineStats | None = None
         self._shed_carry: dict[str, int] = {}
@@ -211,7 +241,12 @@ class FleetEngine:
             eng = self.shards.pop(label, None)
             if eng is None:
                 return
-            self._degraded.add(label)
+            if reason == "scale_down":
+                # planned shrink: the chip is healthy, the label (and
+                # its plan slot) is reusable by a later scale_up
+                self._retired_planned.add(label)
+            else:
+                self._degraded.add(label)
             self._placer.mark_down(label)
             # carry BEFORE the engine goes away — the PR-5 rebuild
             # discipline applied to a placement move: the fleet view
@@ -262,7 +297,117 @@ class FleetEngine:
             elif label not in self.shards:
                 return None
         self._retire(label, reason="scale_down")
+        with self._lock:
+            self.scale_downs += 1
         return label
+
+    def scale_up(self, warm_timeout_s: float = 120.0) -> str | None:
+        """Grow the fleet by one shard (the eighth control law's up
+        action, and the counterpart to :meth:`scale_down`).
+
+        The shard is built from the factory — whose warmup path goes
+        through the persistent AOT cache (evam_tpu/aot/), so a
+        cache-hit spin-up is deserialize-speed — and is **warmed
+        before it joins placement**: no stream is ever pinned to a
+        cold shard. Only once warm does the label enter the shard map
+        and the consistent-hash ring; the streams whose arcs the new
+        vnodes own are checkpointed (pre_rebalance barrier, reason
+        ``scale_up``) and re-pinned, each move counted on
+        ``evam_fleet_rebalance_total``.
+
+        Returns the new label, or None (at capacity, already scaling,
+        or the warm gate timed out — the half-built shard is stopped
+        and nothing joined the ring)."""
+        with self._lock:
+            if self._scaling:
+                return None
+            free = [i for i in range(len(self._plans))
+                    if f"s{i}" not in self.shards
+                    and f"s{i}" not in self._degraded]
+            if not free:
+                return None
+            idx = free[0]
+            label = f"s{idx}"
+            self._scaling = True
+            example = self._example
+        t0 = time.perf_counter()
+        try:
+            try:
+                eng = self._shard_factory(self._plans[idx],
+                                          f"{self.name}@{label}")
+            except Exception:  # noqa: BLE001 — factory failure is a no-op grow
+                log.exception("fleet %s: scale_up build of %s failed",
+                              self.name, label)
+                return None
+            if example:
+                # warm-before-join gate (skipped when the fleet has
+                # never seen an example — matching boot, where shards
+                # are built cold and warm when traffic shapes arrive)
+                try:
+                    eng.set_example(**example)
+                    eng.warm_async(**example)
+                except Exception:  # noqa: BLE001 — warm API optional on fakes
+                    pass
+                deadline = time.monotonic() + warm_timeout_s
+                while not eng.warmed.wait(0.05):
+                    if time.monotonic() >= deadline:
+                        log.warning(
+                            "fleet %s: scale_up of %s abandoned — "
+                            "warmup exceeded %.0fs; the shard never "
+                            "joined the ring", self.name, label,
+                            warm_timeout_s)
+                        threading.Thread(
+                            target=self._safe_stop, args=(eng,),
+                            name=f"fleet-{self.name}-abort-{label}",
+                            daemon=True).start()
+                        return None
+            # join: shard map FIRST, ring second — a submit that races
+            # the ring growth and places onto the new label must find
+            # the engine in ``shards`` (placer.add before the map
+            # insert would KeyError exactly that window)
+            with self._lock:
+                self.shards[label] = eng
+                self._devices[label] = str(
+                    self._plans[idx].mesh.devices.flat[0])
+                self._retired_planned.discard(label)
+                self._placer.add(label)
+                self.scale_ups += 1
+                self._last_spinup_s = time.perf_counter() - t0
+                # which pinned streams the grown ring now owns —
+                # their pins move only after the checkpoint below
+                moving = [s for s, cur in self._pins.items()
+                          if cur != label
+                          and self._placer.place(s) == label]
+            # pre-move checkpoint (outside _lock: capture takes the
+            # store's own locks) so the new shard's first frame sees
+            # the stream's gate/coaster/tracker state, same contract
+            # as a chip-loss migration
+            from evam_tpu.state import active as ckpt_active
+
+            store = ckpt_active()
+            if store is not None:
+                for s in moving:
+                    store.capture(s, barrier="pre_rebalance",
+                                  reason="scale_up")
+            with self._lock:
+                moved = 0
+                for s in moving:
+                    if (self._pins.get(s) != label
+                            and self._placer.place(s) == label):
+                        self._pins[s] = label
+                        self.rebalances += 1
+                        moved += 1
+                        metrics.inc("evam_fleet_rebalance_total",
+                                    labels={"engine": self.name})
+                spinup = self._last_spinup_s
+            log.info(
+                "fleet %s: scaled up — shard %s joined warm in %.2fs, "
+                "%d stream(s) rebalanced onto it", self.name, label,
+                spinup, moved)
+            return label
+        finally:
+            with self._lock:
+                self._scaling = False
 
     @staticmethod
     def _safe_stop(eng) -> None:
@@ -379,12 +524,39 @@ class FleetEngine:
     def retune(self, op) -> None:
         """Broadcast the controller's operating point to every shard
         plus the mesh twin (evam_tpu/control/): the fleet must run one
-        operating point, not whichever shard __getattr__ answers from."""
+        operating point, not whichever shard __getattr__ answers from.
+
+        The eighth law actuates here too: ``op.fleet_shards`` > 0 is
+        the controller's (damped, cooled-down) target fleet size, and
+        each retune moves ONE step toward it — grow on a background
+        thread (warm-before-join takes real seconds and the
+        controller tick must not block), shrink inline through
+        :meth:`scale_down` + checkpointed migration. 0 (the knob's
+        rest state) actuates nothing."""
         for e in self._members():
             try:
                 e.retune(op)
             except Exception:  # noqa: BLE001 — shard mid-teardown
                 pass
+        target = int(getattr(op, "fleet_shards", 0) or 0)
+        if target <= 0:
+            return
+        with self._lock:
+            live = len(self.shards)
+            scaling = self._scaling
+        if target > live and not scaling:
+            threading.Thread(
+                target=self._scale_up_guarded,
+                name=f"fleet-{self.name}-scale-up", daemon=True,
+            ).start()
+        elif target < live and live > 1:
+            self.scale_down()
+
+    def _scale_up_guarded(self) -> None:
+        try:
+            self.scale_up()
+        except Exception:  # noqa: BLE001 — a failed grow must not kill the thread owner
+            log.exception("fleet %s: scale_up failed", self.name)
 
     def abandon(self) -> None:
         for e in self._members():
@@ -436,4 +608,11 @@ class FleetEngine:
                 "degraded_shards": len(self._degraded),
                 "streams": self.placement_counts(),
                 "rebalances": self.rebalances,
+                # autoscaling surface (eighth law): the structural
+                # ceiling (mesh size minus dead chips — the hub clamps
+                # it to EVAM_FLEET_MAX_SHARDS) and the grow/shrink
+                # totals /scheduler explains
+                "max_shards": len(self._plans) - len(self._degraded),
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
             }

@@ -61,6 +61,21 @@ class ConsistentHashPlacer:
                     return s
         raise RuntimeError("unreachable: live ring walk found no shard")
 
+    def add(self, shard: str) -> None:
+        """Grow the ring by one shard (fleet scale-up). A label the
+        ring has seen before (a scale-down's slot coming back) is
+        simply marked live again — its vnodes never left, so the
+        streams it used to own come home deterministically. A genuinely
+        new label inserts its vnodes; only the streams whose arcs the
+        new points split move, the consistent-hash contract."""
+        with self._lock:
+            self._down.discard(shard)
+            if any(s == shard for _, s in self._ring):
+                return
+            for v in range(self._vnodes):
+                bisect.insort(self._ring, (_point(f"{shard}:{v}"), shard))
+            self._points = [p for p, _ in self._ring]
+
     def mark_down(self, shard: str) -> None:
         with self._lock:
             self._down.add(shard)

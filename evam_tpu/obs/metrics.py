@@ -60,6 +60,12 @@ METRIC_SPECS: dict[str, tuple[str, tuple[str, ...]]] = {
     "evam_gate_skipped": ("counter", ("engine",)),
     # fleet
     "evam_fleet_rebalance_total": ("counter", ("engine",)),
+    # persistent AOT executable cache (evam_tpu/aot/): confirmed
+    # serves, misses by fallback-ladder rung (absent/version/crc/
+    # deserialize/execute), and the on-disk store size after eviction
+    "evam_aot_cache_hits": ("counter", ("engine",)),
+    "evam_aot_cache_misses": ("counter", ("engine", "reason")),
+    "evam_aot_cache_bytes": ("gauge", ()),
     # publishing + EII bridge
     "evam_publish_dropped": ("counter", ("dest",)),
     "evam_eii_published": ("counter", ()),

@@ -195,6 +195,12 @@ def build_app(
         from evam_tpu.stages.gate import registry as gate_registry
 
         ready["gate"] = gate_registry.summary()
+        # persistent AOT executable cache (evam_tpu/aot/): entry/byte
+        # counts, hits and the per-reason miss ladder. Fixed keys from
+        # boot, zeros with EVAM_AOT=off — golden shape.
+        from evam_tpu.aot import summary as aot_summary
+
+        ready["aot"] = aot_summary()
         # shared-ingest visibility: the demux/pool serve EVERY live
         # stream — a monitoring consumer needs their frame counters
         # next to engine readiness

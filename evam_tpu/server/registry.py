@@ -51,13 +51,17 @@ class PipelineRegistry:
                 shape=list(settings.tpu.mesh_shape),
                 axes=list(settings.tpu.mesh_axes),
             )
-            if (settings.tpu.fleet == "sharded"
-                    and settings.tpu.fleet_shards > 0):
+            n_devices = max(settings.tpu.fleet_shards,
+                            settings.tpu.fleet_max_shards)
+            if settings.tpu.fleet == "sharded" and n_devices > 0:
                 # canary/bench knob: shard over the first N chips only
-                # (scaling curves, partial-fleet rollout)
+                # (scaling curves, partial-fleet rollout). With
+                # autoscaling the MESH must span the ceiling — the
+                # fleet boots at EVAM_FLEET_SHARDS shards and grows
+                # into the remaining plan slots via scale_up().
                 import jax
 
-                devices = list(jax.devices())[:settings.tpu.fleet_shards]
+                devices = list(jax.devices())[:n_devices]
                 plan = build_mesh(devices=devices)
             registry = ModelRegistry(
                 models_dir=settings.models_dir,
@@ -85,6 +89,14 @@ class PipelineRegistry:
                 ragged_unit_budget=settings.tpu.ragged_unit_budget,
                 fleet=settings.tpu.fleet,
                 fleet_shard_max_batch=settings.tpu.fleet_shard_max_batch,
+                fleet_max_shards=settings.tpu.fleet_max_shards,
+                # boot size only meaningful under an autoscaling
+                # ceiling — without one the fleet spans the plan, the
+                # pre-autoscaling behavior (fleet_shards narrowed the
+                # mesh itself above)
+                fleet_initial_shards=(
+                    settings.tpu.fleet_shards
+                    if settings.tpu.fleet_max_shards > 0 else 0),
             )
         self.hub = hub
         #: QoS layer (evam_tpu/sched/): the hub's sched config is the
@@ -433,7 +445,8 @@ class PipelineRegistry:
         fleet_fn = getattr(self.hub, "fleet_summary", None)
         out["fleet"] = (fleet_fn() if fleet_fn is not None else {
             "mode": "off", "shards": 0, "degraded_shards": 0,
-            "rebalances": 0, "streams": {}})
+            "rebalances": 0, "streams": {},
+            "max_shards": 0, "scale_ups": 0, "scale_downs": 0})
         # self-tuning operating point (evam_tpu/control/): the current
         # setpoints, the signals that produced them, and the last N
         # control actions with reasons — the same fixed shape (with

@@ -68,6 +68,7 @@ def _reset_fault_memo():
     teardown restoring the env; restore the memo with it so a stale
     injector never leaks into the next test's engines."""
     yield
+    from evam_tpu import aot
     from evam_tpu.control import state as control_state
     from evam_tpu.obs import faults, trace
 
@@ -79,6 +80,9 @@ def _reset_fault_memo():
     # a leaked live operating point would silently retune every
     # engine built by the next test
     control_state.reset_cache()
+    # ... and the AOT executable cache (evam_tpu/aot/): a leaked live
+    # cache would serve stale executables to the next test's engines
+    aot.reset_cache()
 
 
 @pytest.fixture(scope="session")

@@ -207,6 +207,110 @@ class TestLaws:
         assert p["staleness_scale"][0] == 1.0
 
 
+# --------------------------------------------- the eighth law (PR 18)
+
+
+class _FleetHub(_FakeHub):
+    """Fake hub with the fleet_summary surface signals() reads."""
+
+    def __init__(self, shards=2, max_shards=4, **kw):
+        super().__init__(**kw)
+        self.fleet = {"shards": shards, "max_shards": max_shards}
+
+    def fleet_summary(self):
+        return dict(self.fleet)
+
+
+class TestEighthLaw:
+    def test_saturation_spawns_a_shard(self):
+        p = _proposals(_controller(),
+                       _sig(utilization=0.95, fleet_shards=2,
+                            fleet_max_shards=4))
+        value, why = p["fleet_shards"]
+        assert value == 3 and "spawn" in why
+
+    def test_idleness_drains_a_shard(self):
+        p = _proposals(_controller(),
+                       _sig(utilization=0.2, fleet_shards=3,
+                            fleet_max_shards=4))
+        value, why = p["fleet_shards"]
+        assert value == 2 and "drain" in why
+
+    def test_thresholds_sit_outside_the_utilization_band(self):
+        # util_hi (0.80) stretches deadlines but must NOT buy a chip;
+        # the fleet law waits for scale_up_util (0.90)
+        p = _proposals(_controller(),
+                       _sig(utilization=0.85, fleet_shards=2,
+                            fleet_max_shards=4))
+        assert "fleet_shards" not in p
+        assert p["deadline_scale"][0] == 1.25
+        # util_lo (0.50) shrinks deadlines without draining a shard
+        p = _proposals(_controller(),
+                       _sig(utilization=0.4, fleet_shards=2,
+                            fleet_max_shards=4))
+        assert "fleet_shards" not in p
+
+    def test_never_above_max_or_below_one(self):
+        p = _proposals(_controller(),
+                       _sig(utilization=0.95, fleet_shards=4,
+                            fleet_max_shards=4))
+        assert "fleet_shards" not in p
+        p = _proposals(_controller(),
+                       _sig(utilization=0.1, fleet_shards=1,
+                            fleet_max_shards=4))
+        assert "fleet_shards" not in p
+
+    def test_inert_without_a_max_shards_ceiling(self):
+        # EVAM_FLEET_MAX_SHARDS unset (or fleet off) = max_shards 0:
+        # the law proposes nothing, autoscaling is strictly opt-in
+        p = _proposals(_controller(),
+                       _sig(utilization=0.95, fleet_shards=2))
+        assert "fleet_shards" not in p
+
+    def test_configurable_thresholds(self):
+        ctrl = _controller(scale_up_util=0.7, scale_down_util=0.1)
+        p = _proposals(ctrl, _sig(utilization=0.75, fleet_shards=2,
+                                  fleet_max_shards=4))
+        assert p["fleet_shards"][0] == 3
+        p = _proposals(ctrl, _sig(utilization=0.2, fleet_shards=2,
+                                  fleet_max_shards=4))
+        assert "fleet_shards" not in p
+
+    def test_knob_rests_once_the_fleet_arrives(self):
+        # target reached (or overtaken by a manual move) inside the
+        # dead band: track the live count so retune stops re-actuating
+        p = _proposals(_controller(),
+                       _sig(utilization=0.6, fleet_shards=3,
+                            fleet_max_shards=4),
+                       OperatingPoint(fleet_shards=4))
+        assert p["fleet_shards"][0] == 3
+
+    def test_signals_read_the_hubs_fleet_summary(self):
+        ctrl = _controller(hub=_FleetHub(shards=3, max_shards=8))
+        sig = ctrl.signals()
+        assert sig["fleet_shards"] == 3.0
+        assert sig["fleet_max_shards"] == 8.0
+
+    def test_hubs_without_a_fleet_leave_the_zeros(self):
+        sig = _controller(hub=_FakeHub()).signals()
+        assert sig["fleet_shards"] == 0.0
+        assert sig["fleet_max_shards"] == 0.0
+
+    def test_tick_actuates_through_the_damping_machinery(self):
+        hub = _FleetHub(shards=2, max_shards=4)
+        ctrl = _controller(hub=hub, damping=2, cooldown=0)
+        ctrl.signals = lambda: _sig(utilization=0.95, fleet_shards=2.0,
+                                    fleet_max_shards=4.0)
+        ctrl.tick()
+        assert ctrl.state.op.fleet_shards == 0  # damped: one tick only
+        ctrl.tick()
+        assert ctrl.state.op.fleet_shards == 3  # sustained: actuate
+        assert hub.retuned[-1].fleet_shards == 3
+        why = [a for a in ctrl.state.snapshot()["actions"]
+               if a["knob"] == "fleet_shards"]
+        assert why and "spawn" in why[-1]["reason"]
+
+
 # ------------------------------------------- damping / cooldown / pins
 
 

@@ -12,6 +12,7 @@ supervised engines is tools/fleet_soak.py's job (slow battery)."""
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from types import SimpleNamespace
 
@@ -61,6 +62,34 @@ class TestPlacer:
         p.mark_down("s0")
         with pytest.raises(RuntimeError):
             p.place("cam")
+
+    def test_add_moves_only_the_new_shards_streams(self):
+        """Ring growth (scale_up satellite): adding a shard must equal
+        a fresh ring built with it — and therefore move ONLY the
+        streams whose arcs the new vnodes own."""
+        grown = ConsistentHashPlacer([f"s{i}" for i in range(4)])
+        keys = [f"cam{i}" for i in range(200)]
+        before = {k: grown.place(k) for k in keys}
+        grown.add("s4")
+        fresh = ConsistentHashPlacer([f"s{i}" for i in range(5)])
+        moved = 0
+        for k in keys:
+            assert grown.place(k) == fresh.place(k)
+            if grown.place(k) != before[k]:
+                assert grown.place(k) == "s4"  # moves only TO the new
+                moved += 1
+        assert 0 < moved < len(keys)
+
+    def test_down_then_add_brings_streams_home(self):
+        """A scale-down + later scale-up of the same label restores
+        the original placement exactly — vnodes never left the ring,
+        so returning streams land where they were."""
+        p = ConsistentHashPlacer([f"s{i}" for i in range(4)])
+        keys = [f"cam{i}" for i in range(100)]
+        before = {k: p.place(k) for k in keys}
+        p.mark_down("s2")
+        p.add("s2")
+        assert {k: p.place(k) for k in keys} == before
 
     def test_fleet_mode_validation(self, monkeypatch):
         assert fleet_mode("sharded") == "sharded"
@@ -126,7 +155,7 @@ class _FakeShard:
         self.stopped = True
 
 
-def _fake_fleet(n=4):
+def _fake_fleet(n=4, initial=0):
     plans = build_mesh().per_device_plans()[:n]
     shards: dict[str, _FakeShard] = {}
 
@@ -135,7 +164,7 @@ def _fake_fleet(n=4):
         shards[label.split("@")[-1]] = s
         return s
 
-    eng = FleetEngine("detect:m", factory, plans)
+    eng = FleetEngine("detect:m", factory, plans, initial=initial)
     return eng, shards
 
 
@@ -202,6 +231,152 @@ class TestFleetEngine:
         next(iter(shards.values())).state = "degraded"
         eng._sweep_degraded()
         assert eng.state == "running"  # /healthz must not 503 the pod
+
+
+# ------------------------------------------------ elastic fleet (PR 18)
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+class TestScaleUp:
+    def test_initial_builds_a_subset_of_the_plans(self):
+        eng, shards = _fake_fleet(n=4, initial=2)
+        assert sorted(shards) == ["s0", "s1"]
+        summary = eng.fleet_summary()
+        assert summary["shards"] == 2
+        assert summary["max_shards"] == 4  # the structural ceiling
+
+    def test_scale_up_joins_warm_and_rebalances_deterministically(self):
+        eng, shards = _fake_fleet(n=4, initial=2)
+        eng.set_example(frames=np.zeros(1))
+        keys = [f"cam{i}" for i in range(40)]
+        for k in keys:
+            eng.submit(stream=k, frames=np.zeros(1))
+        label = eng.scale_up()
+        assert label == "s2"
+        assert label in eng.shards  # joined the shard map AND ring
+        # every pin matches the grown ring — the moved streams are
+        # exactly the ones the new vnodes own, each move counted
+        moved = 0
+        for k in keys:
+            assert eng._pins[k] == eng._placer.place(k)
+            if eng._pins[k] == label:
+                moved += 1
+        assert moved > 0 and eng.rebalances >= moved
+        summary = eng.fleet_summary()
+        assert summary["shards"] == 3
+        assert summary["scale_ups"] == 1
+        assert eng._last_spinup_s >= 0.0
+
+    def test_scale_up_refuses_at_plan_capacity(self):
+        eng, shards = _fake_fleet(n=2, initial=2)
+        assert eng.scale_up() is None
+        assert eng.fleet_summary()["scale_ups"] == 0
+
+    def test_scale_up_reuses_a_planned_retirement_slot(self):
+        """scale_down retires a healthy chip — its label (and plan
+        slot) must come back on the next grow, so the ring's vnodes
+        bring its streams home (the placer determinism above)."""
+        eng, shards = _fake_fleet(n=3, initial=3)
+        retired = eng.scale_down()
+        assert retired == "s2"
+        assert eng.fleet_summary()["scale_downs"] == 1
+        assert eng.scale_up() == "s2"
+        assert eng.fleet_summary()["shards"] == 3
+
+    def test_scale_up_never_reuses_a_dead_chip(self):
+        eng, shards = _fake_fleet(n=3, initial=3)
+        shards["s1"].state = "degraded"
+        eng._sweep_degraded()  # chip loss: s1's plan index is dead
+        assert eng.scale_up() is None  # s0/s2 live, s1 unusable
+        summary = eng.fleet_summary()
+        assert summary["degraded_shards"] == 1
+        assert summary["max_shards"] == 2  # ceiling shrank with the chip
+
+    def test_scale_up_warm_timeout_never_joins_cold(self):
+        plans = build_mesh().per_device_plans()[:2]
+        built: list[_FakeShard] = []
+
+        def factory(plan, label):
+            s = _FakeShard(label)
+            if built:  # the grown shard never warms
+                s.warmed.clear()
+            built.append(s)
+            return s
+
+        eng = FleetEngine("detect:m", factory, plans, initial=1)
+        eng.set_example(frames=np.zeros(1))
+        assert eng.scale_up(warm_timeout_s=0.05) is None
+        assert "s1" not in eng.shards
+        assert eng.fleet_summary()["scale_ups"] == 0
+        assert _wait(lambda: built[1].stopped)  # abandoned, not leaked
+
+    def test_concurrent_scale_up_is_single_flight(self):
+        eng, shards = _fake_fleet(n=4, initial=2)
+        with eng._lock:
+            eng._scaling = True
+        assert eng.scale_up() is None
+        with eng._lock:
+            eng._scaling = False
+        assert eng.scale_up() == "s2"
+
+    def test_retune_moves_one_step_toward_the_target(self):
+        from evam_tpu.control.state import OperatingPoint
+
+        eng, shards = _fake_fleet(n=4, initial=2)
+        eng.set_example(frames=np.zeros(1))
+        # grow runs on a background thread (warm-before-join must not
+        # block the controller tick) — one step per push
+        eng.retune(OperatingPoint(fleet_shards=4))
+        assert _wait(lambda: len(eng.shards) == 3)
+        assert _wait(lambda: not eng._scaling)
+        eng.retune(OperatingPoint(fleet_shards=4))
+        assert _wait(lambda: len(eng.shards) == 4)
+        # shrink is inline, also one step
+        eng.retune(OperatingPoint(fleet_shards=1))
+        assert len(eng.shards) == 3
+        # the knob's rest state actuates nothing
+        eng.retune(OperatingPoint(fleet_shards=0))
+        assert _wait(lambda: not eng._scaling)
+        assert len(eng.shards) == 3
+
+    def test_scale_up_checkpoints_moving_streams(self, monkeypatch):
+        """The warm shard's first frame must see each migrated
+        stream's gate/coaster/tracker state: the pre_rebalance barrier
+        fires for every moving pin, tagged reason=scale_up."""
+        from evam_tpu import state as ckpt
+        from evam_tpu.config.settings import reset_settings
+
+        monkeypatch.setenv("EVAM_CKPT", "1")
+        reset_settings()
+        ckpt.reset_cache()
+        try:
+            eng, shards = _fake_fleet(n=4, initial=2)
+            eng.set_example(frames=np.zeros(1))
+            keys = [f"cam{i}" for i in range(40)]
+            for k in keys:
+                eng.submit(stream=k, frames=np.zeros(1))
+            captured: list[tuple[str, str]] = []
+            store = ckpt.active()
+            monkeypatch.setattr(
+                store, "capture",
+                lambda s, barrier="", reason="": captured.append(
+                    (s, barrier, reason)))
+            label = eng.scale_up()
+            moved = [k for k in keys if eng._pins[k] == label]
+            assert moved
+            assert sorted(captured) == sorted(
+                (k, "pre_rebalance", "scale_up") for k in moved)
+        finally:
+            ckpt.reset_cache()
+            reset_settings()
 
 
 # ------------------------------------------------- fleet admission

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Cold vs cache-hit spin-up: the AOT executable cache's CI gate.
+
+Three child processes build the same tiny serving hub and measure
+spin-up-to-first-batch (hub build + full bucket warmup + one served
+batch), then run a seeded frame set and digest the raw result bytes:
+
+* ``cold``  — EVAM_AOT=on against an empty cache dir: every bucket is
+  an ``absent`` miss, compiled ahead-of-time once and stored.
+* ``warm``  — EVAM_AOT=on against the now-populated dir: every bucket
+  must deserialize (aot_hits == buckets, zero compile seconds) — the
+  elastic-fleet scale-up path in miniature.
+* ``off``   — EVAM_AOT unset (the default): the plain jit path.
+
+Gates: all three digests are BIT-IDENTICAL (the cache may change
+where an executable comes from, never a number), the warm child hit
+on every bucket, and (full mode only — CI shares cores) the warm
+spin-up beats the acceptance bound and the cold spin-up. Prints ONE
+JSON line on stdout; diagnostics on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EVAM_ALLOW_RANDOM_WEIGHTS", "1")
+os.environ.setdefault("EVAM_LOG_LEVEL", "warning")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+MODEL = "object_detection/person_vehicle_bike"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def child(args) -> int:
+    """One measured spin-up in a fresh process (the cache is
+    process-memoized — cold/warm/off must not share a jit cache)."""
+    import numpy as np
+
+    from evam_tpu.engine.hub import EngineHub
+    from evam_tpu.models import ModelRegistry, ZOO_SPECS
+    from evam_tpu.ops.color import wire_shape
+
+    # clock starts AFTER the interpreter/jax imports: a fleet scale-up
+    # happens inside a running process, so the number that gates is
+    # registry + hub build + full bucket warmup + one served batch —
+    # the same span FleetEngine._last_spinup_s measures
+    t0 = time.perf_counter()
+    overrides = {k: (64, 64) for k in ZOO_SPECS}
+    overrides["audio_detection/environment"] = (1, 1600)
+    registry = ModelRegistry(
+        dtype="float32", input_overrides=overrides,
+        width_overrides={k: 8 for k in ZOO_SPECS})
+    hub = EngineHub(registry, plan=None, max_batch=8, deadline_ms=2.0,
+                    supervise=False, stall_timeout_s=0)
+    eng = hub.engine("detect", MODEL)
+    frame = np.zeros(tuple(wire_shape("i420", 64, 64)), np.uint8)
+    eng.set_example(frames=frame)
+    eng.warmup()
+    eng.submit(stream="bench", frames=frame).result(timeout=300)
+    spinup_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(7)
+    digest = hashlib.sha256()
+    for _ in range(args.frames):
+        f = rng.integers(0, 256, frame.shape, np.uint8)
+        out = eng.submit(stream="bench", frames=f).result(timeout=300)
+        for leaf in (out if isinstance(out, (list, tuple)) else [out]):
+            digest.update(np.ascontiguousarray(leaf).tobytes())
+
+    print(json.dumps({
+        "spinup_s": round(spinup_s, 4),
+        "digest": digest.hexdigest(),
+        "buckets": len(eng.buckets),
+        "aot_hits": eng.stats.aot_hits,
+        "compile_s": round(eng.stats.compile_seconds, 4),
+        "aot_load_s": round(eng.stats.aot_load_seconds, 4),
+    }))
+    hub.stop()
+    return 0
+
+
+def run_child(mode: str, aot_dir: str, frames: int) -> dict:
+    env = dict(os.environ)
+    env.pop("EVAM_AOT", None)
+    env.pop("EVAM_AOT_DIR", None)
+    if mode != "off":
+        env["EVAM_AOT"] = "1"
+        env["EVAM_AOT_DIR"] = aot_dir
+    out = subprocess.run(
+        [sys.executable, __file__, "--child", "--frames", str(frames)],
+        capture_output=True, text=True, timeout=900, env=env)
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        raise RuntimeError(f"{mode} child failed rc={out.returncode}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    log(f"{mode}: spinup {rec['spinup_s']}s, aot_hits "
+        f"{rec['aot_hits']}/{rec['buckets']}, compile "
+        f"{rec['compile_s']}s, load {rec['aot_load_s']}s")
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--frames", type=int, default=12)
+    ap.add_argument("--smoke", action="store_true",
+                    help="identity + hit gates only (no wall-clock "
+                         "gate: CI runners share cores)")
+    ap.add_argument("--gate-s", type=float, default=5.0,
+                    help="warm spin-up-to-first-batch bound (full "
+                         "mode; the ISSUE-18 acceptance number)")
+    args = ap.parse_args()
+    if args.child:
+        return child(args)
+
+    with tempfile.TemporaryDirectory(prefix="evam_aot_bench_") as d:
+        cold = run_child("cold", d, args.frames)
+        warm = run_child("warm", d, args.frames)
+        off = run_child("off", d, args.frames)
+
+    identical = (cold["digest"] == warm["digest"] == off["digest"])
+    all_hit = (warm["aot_hits"] == warm["buckets"]
+               and warm["compile_s"] == 0.0)
+    populated = cold["aot_hits"] == 0 and cold["compile_s"] > 0.0
+    ok = identical and all_hit and populated
+    if not args.smoke:
+        ok = ok and warm["spinup_s"] < args.gate_s
+        ok = ok and warm["spinup_s"] < cold["spinup_s"]
+
+    print(json.dumps({
+        "metric": "aot_warm_spinup_s",
+        "value": warm["spinup_s"],
+        "unit": "s",
+        "vs_baseline": round(warm["spinup_s"] - cold["spinup_s"], 4),
+        "ok": ok,
+        "cold_spinup_s": cold["spinup_s"],
+        "off_spinup_s": off["spinup_s"],
+        "bit_identical": identical,
+        "warm_hits": warm["aot_hits"],
+        "buckets": warm["buckets"],
+        "warm_compile_s": warm["compile_s"],
+        "smoke": args.smoke,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
